@@ -1,0 +1,21 @@
+"""mythril_tpu — a TPU-native symbolic-execution framework for EVM bytecode.
+
+Capability surface modeled on Mythril (reference: /root/reference, see SURVEY.md):
+symbolic execution + SMT solving + taint-style annotation tracking detecting
+SWC-classified vulnerabilities, exposed through a `myth`-compatible CLI.
+
+Architecture (TPU-first, not a port):
+  - ``mythril_tpu.smt``      — own term IR + bit-vector solver stack (no z3 in this
+                               environment; a from-scratch bit-blasting CDCL solver with a
+                               C++ core is the decision procedure; a batched JAX
+                               unit-propagation solver discharges frontier feasibility
+                               checks on TPU).
+  - ``mythril_tpu.core``     — the LASER-equivalent symbolic EVM (object interpreter:
+                               the semantic oracle) plus engine services.
+  - ``mythril_tpu.parallel`` — the TPU execution backend: SoA StateBatch, lockstep
+                               jitted opcode stepping, mask-forking, sharded frontier
+                               over a jax.sharding.Mesh.
+  - ``mythril_tpu.analysis`` — detection modules, witness extraction, reports.
+"""
+
+__version__ = "0.1.0"

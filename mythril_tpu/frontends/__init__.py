@@ -1,0 +1,4 @@
+from .disassembler import Disassembly, EvmInstruction, disassemble
+from .asm import assemble, Assembler
+
+__all__ = ["Disassembly", "EvmInstruction", "disassemble", "assemble", "Assembler"]

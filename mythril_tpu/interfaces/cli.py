@@ -1,0 +1,50 @@
+"""`myth-tpu` command-line interface.
+
+Capability parity target: mythril/interfaces/cli.py (subcommands analyze|a,
+disassemble|d, concolic, safe-functions, read-storage, function-to-hash,
+hash-to-address, list-detectors, version — reference cli.py:243-356). Milestone-1
+stub: disassemble and version are live; analyze lands with the engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    from .. import __version__
+
+    parser = argparse.ArgumentParser(prog="myth-tpu",
+                                     description="TPU-native EVM security analysis")
+    subparsers = parser.add_subparsers(dest="command")
+
+    disasm = subparsers.add_parser("disassemble", aliases=["d"],
+                                   help="disassemble EVM bytecode")
+    disasm.add_argument("-c", "--code", help="hex bytecode", default=None)
+    disasm.add_argument("-f", "--codefile", help="file containing hex bytecode",
+                        default=None)
+
+    subparsers.add_parser("version", help="print version")
+
+    args = parser.parse_args(argv)
+    if args.command in ("disassemble", "d"):
+        from ..frontends import Disassembly
+
+        code = args.code
+        if code is None and args.codefile:
+            with open(args.codefile) as handle:
+                code = handle.read().strip()
+        if not code:
+            parser.error("provide -c or -f")
+        sys.stdout.write(Disassembly(code).get_easm())
+        return 0
+    if args.command == "version":
+        print(f"myth-tpu {__version__}")
+        return 0
+    parser.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from .opcodes import OPCODES, ADDRESS, GAS, STACK, opcode_by_number, opcode_name
+
+__all__ = ["OPCODES", "ADDRESS", "GAS", "STACK", "opcode_by_number", "opcode_name"]

@@ -1,0 +1,20 @@
+from .keccak import keccak256, keccak256_int
+from .helpers import (
+    TT256,
+    TT256M1,
+    TT255,
+    ceil32,
+    to_signed,
+    to_unsigned,
+    zpad,
+    generate_contract_address,
+    generate_salted_address,
+    get_code_hash,
+    sha3,
+)
+
+__all__ = [
+    "keccak256", "keccak256_int", "TT256", "TT256M1", "TT255", "ceil32",
+    "to_signed", "to_unsigned", "zpad", "generate_contract_address",
+    "generate_salted_address", "get_code_hash", "sha3",
+]

@@ -1,0 +1,8 @@
+#!/bin/sh
+# Build the native core (keccak + CDCL SAT solver) into one shared library.
+# Pure-Python fallbacks exist for every symbol here; the framework works unbuilt.
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+g++ -O2 -fPIC -shared -std=c++17 -o build/libmythril_native.so keccak.cpp cdcl.cpp
+echo "built native/build/libmythril_native.so"

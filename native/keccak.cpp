@@ -1,0 +1,75 @@
+// Keccak-256 (original multi-rate padding, as used by Ethereum) — C++ core.
+// Exposed via a C ABI consumed through ctypes (mythril_tpu/utils/keccak.py).
+// The pure-Python implementation in that module is the test oracle for this one.
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+constexpr uint64_t RC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808AULL,
+    0x8000000080008000ULL, 0x000000000000808BULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008AULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000AULL,
+    0x000000008000808BULL, 0x800000000000008BULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800AULL, 0x800000008000000AULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+
+constexpr int ROT[25] = {0,  1,  62, 28, 27, 36, 44, 6,  55, 20, 3,  10, 43,
+                         25, 39, 41, 45, 15, 21, 8,  18, 2,  61, 56, 14};
+
+inline uint64_t rotl(uint64_t v, int s) {
+  return s == 0 ? v : (v << s) | (v >> (64 - s));
+}
+
+void keccak_f(uint64_t st[25]) {
+  for (int round = 0; round < 24; ++round) {
+    uint64_t c[5], d[5], b[25];
+    for (int x = 0; x < 5; ++x)
+      c[x] = st[x] ^ st[x + 5] ^ st[x + 10] ^ st[x + 15] ^ st[x + 20];
+    for (int x = 0; x < 5; ++x)
+      d[x] = c[(x + 4) % 5] ^ rotl(c[(x + 1) % 5], 1);
+    for (int x = 0; x < 5; ++x)
+      for (int y = 0; y < 25; y += 5) st[x + y] ^= d[x];
+    for (int x = 0; x < 5; ++x)
+      for (int y = 0; y < 5; ++y)
+        b[y + 5 * ((2 * x + 3 * y) % 5)] = rotl(st[x + 5 * y], ROT[x + 5 * y]);
+    for (int x = 0; x < 5; ++x)
+      for (int y = 0; y < 25; y += 5)
+        st[x + y] = b[x + y] ^ ((~b[(x + 1) % 5 + y]) & b[(x + 2) % 5 + y]);
+    st[0] ^= RC[round];
+  }
+}
+
+}  // namespace
+
+extern "C" void mtpu_keccak256(const char* data, size_t len, char* out32) {
+  constexpr size_t kRate = 136;
+  uint64_t st[25] = {0};
+  const uint8_t* in = reinterpret_cast<const uint8_t*>(data);
+
+  while (len >= kRate) {
+    for (size_t i = 0; i < kRate / 8; ++i) {
+      uint64_t lane;
+      std::memcpy(&lane, in + 8 * i, 8);
+      st[i] ^= lane;  // little-endian host assumed (x86/ARM)
+    }
+    keccak_f(st);
+    in += kRate;
+    len -= kRate;
+  }
+
+  uint8_t block[kRate] = {0};
+  std::memcpy(block, in, len);
+  block[len] = 0x01;
+  block[kRate - 1] |= 0x80;
+  for (size_t i = 0; i < kRate / 8; ++i) {
+    uint64_t lane;
+    std::memcpy(&lane, block + 8 * i, 8);
+    st[i] ^= lane;
+  }
+  keccak_f(st);
+  std::memcpy(out32, st, 32);
+}

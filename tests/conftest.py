@@ -1,0 +1,28 @@
+"""Test configuration.
+
+JAX-dependent tests run on a virtual 8-device CPU mesh (no TPU needed in CI) — the
+devices are created before jax initializes via the env flags below. Keep these at the
+very top so any transitive jax import sees them.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_device_mesh():
+    import jax
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual devices")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices[:8]).reshape(2, 4), ("dp", "mp"))

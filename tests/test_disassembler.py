@@ -1,0 +1,70 @@
+"""Disassembler + assembler round-trips and selector-table recovery
+(test strategy parity: reference tests/disassembler/*)."""
+
+from mythril_tpu.frontends import Disassembly, assemble, disassemble
+from mythril_tpu.frontends.asm import creation_wrapper, dispatcher, selector
+from mythril_tpu.frontends.disassembler import find_op_code_sequence
+
+
+def test_disassemble_basic():
+    # PUSH1 0x60 PUSH1 0x40 MSTORE STOP
+    instructions = disassemble("0x6060604052" + "00")
+    ops = [i.op_code for i in instructions]
+    assert ops == ["PUSH1", "PUSH1", "MSTORE", "STOP"]
+    assert instructions[0].argument == "0x60"
+    assert instructions[1].address == 2
+
+
+def test_truncated_push_immediate():
+    instructions = disassemble("0x61aa")  # PUSH2 with only one immediate byte
+    assert instructions[0].op_code == "PUSH2"
+    assert instructions[0].argument == "0xaa"
+
+
+def test_assemble_labels_roundtrip():
+    code = assemble("""
+        PUSH1 0x00
+        PUSH @target
+        JUMP
+        STOP
+    target:
+        JUMPDEST
+        PUSH1 0x2a
+        STOP
+    """)
+    instructions = disassemble(code)
+    ops = [i.op_code for i in instructions]
+    assert "JUMPDEST" in ops
+    jumpdest_addr = next(i.address for i in instructions if i.op_code == "JUMPDEST")
+    push2 = next(i for i in instructions if i.op_code == "PUSH2")
+    assert int(push2.argument, 16) == jumpdest_addr
+
+
+def test_dispatcher_selector_recovery():
+    source = dispatcher({
+        "withdraw()": "PUSH1 0x01\nPUSH1 0x00\nSSTORE\nSTOP",
+        "deposit()": "STOP",
+    })
+    runtime = assemble(source)
+    disassembly = Disassembly(runtime.hex())
+    recovered = {h.lower() for h in disassembly.func_hashes}
+    assert f"0x{selector('withdraw()'):08x}" in recovered
+    assert f"0x{selector('deposit()'):08x}" in recovered
+    # jump targets resolve to JUMPDESTs
+    for addr in disassembly.address_to_function_name:
+        assert addr in disassembly.valid_jump_destinations
+
+
+def test_find_op_code_sequence():
+    instructions = disassemble(assemble("PUSH1 0x01\nPUSH1 0x02\nADD\nSTOP"))
+    hits = list(find_op_code_sequence([["PUSH1"], ["ADD"]], instructions))
+    assert hits == [1]
+
+
+def test_creation_wrapper_returns_runtime():
+    runtime = assemble("PUSH1 0x2a\nPUSH1 0x00\nMSTORE\nSTOP")
+    creation = creation_wrapper(runtime)
+    # the runtime image must be embedded verbatim at the tail
+    assert creation.endswith(runtime)
+    instructions = disassemble(creation)
+    assert instructions[3].op_code == "CODECOPY"
