@@ -30,12 +30,16 @@ class AsmError(Exception):
 
 
 def _encode_push(value: int, width: int | None = None) -> bytes:
+    if value < 0:
+        raise AsmError(f"push value must be non-negative: {value}")
     if value == 0 and width is None:
         width = 1  # PUSH1 0x00 (portable to pre-Shanghai; PUSH0 only when explicit)
     if width is None:
         width = max(1, (value.bit_length() + 7) // 8)
     if width > 32:
         raise AsmError(f"push value too wide: {value}")
+    if value >= 1 << (8 * width):
+        raise AsmError(f"value {value:#x} does not fit PUSH{width}")
     return bytes([0x5F + width]) + value.to_bytes(width, "big")
 
 

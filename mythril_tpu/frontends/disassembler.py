@@ -30,15 +30,23 @@ class EvmInstruction:
         return result
 
 
+import re as _re
+
+# solc unlinked-library placeholders, both styles, are exactly 40 chars and must be
+# zero-FILLED (not stripped) so byte offsets stay aligned:
+#   0.5+:  __$<34 hex>$__      pre-0.5: __<36 chars of name/padding>__
+_PLACEHOLDER_RE = _re.compile(r"__\$.{34}\$__|__.{36}__")
+
+
 def _normalize(code: str | bytes) -> bytes:
     if isinstance(code, (bytes, bytearray)):
         return bytes(code)
     code = code.strip()
     if code.startswith("0x"):
         code = code[2:]
-    # Unlinked solidity placeholders (__LibraryName__...) become zero bytes.
     if "_" in code:
-        code = "".join("0" if ch == "_" else ch for ch in code)
+        code = _PLACEHOLDER_RE.sub("0" * 40, code)
+        code = code.replace("_", "0")  # stray underscores, length-preserving
     if len(code) % 2:
         code = code[:-1]  # tolerate trailing half-byte as the reference tooling does
     try:
@@ -89,7 +97,7 @@ class Disassembly:
     """
 
     bytecode: str
-    enable_online_lookup: bool = False
+    enable_online_lookup: Optional[bool] = None
     instruction_list: List[EvmInstruction] = field(default_factory=list)
     func_hashes: List[str] = field(default_factory=list)
     function_name_to_address: Dict[str, int] = field(default_factory=dict)
@@ -107,23 +115,26 @@ class Disassembly:
         self._recover_selector_table()
 
     # -- function selector recovery ------------------------------------------------
-    # (pattern, inverted): when the comparison is negated with ISZERO, JUMPI jumps on
-    # selector MISmatch, so the function entry is the fall-through after JUMPI.
+    # (pattern, selector_offset, inverted): selector pushes are PUSH1..PUSH4 (the solc
+    # optimizer shortens selectors with leading zero bytes). When the comparison is
+    # negated with ISZERO, JUMPI jumps on selector MISmatch, so the function entry is
+    # the fall-through after JUMPI.
+    _SELECTOR_PUSH = ["PUSH1", "PUSH2", "PUSH3", "PUSH4"]
+    _TARGET_PUSH = ["PUSH1", "PUSH2", "PUSH3", "PUSH4"]
     _DISPATCH_PATTERNS = [
-        ([["PUSH4"], ["EQ"], ["PUSH1", "PUSH2", "PUSH3", "PUSH4"], ["JUMPI"]], False),
-        ([["DUP1"], ["PUSH4"], ["EQ"], ["PUSH1", "PUSH2", "PUSH3", "PUSH4"], ["JUMPI"]], False),
-        ([["PUSH4"], ["EQ"], ["ISZERO"], ["PUSH1", "PUSH2", "PUSH3", "PUSH4"], ["JUMPI"]], True),
+        ([_SELECTOR_PUSH, ["EQ"], _TARGET_PUSH, ["JUMPI"]], 0, False),
+        ([["DUP1"], _SELECTOR_PUSH, ["EQ"], _TARGET_PUSH, ["JUMPI"]], 1, False),
+        ([_SELECTOR_PUSH, ["EQ"], ["ISZERO"], _TARGET_PUSH, ["JUMPI"]], 0, True),
     ]
 
     def _recover_selector_table(self) -> None:
         from ..support.signatures import SignatureDB
 
         sig_db = SignatureDB(enable_online_lookup=self.enable_online_lookup)
-        for pattern, inverted in self._DISPATCH_PATTERNS:
+        for pattern, selector_offset, inverted in self._DISPATCH_PATTERNS:
             for index in find_op_code_sequence(pattern, self.instruction_list):
-                push4 = next(ins for ins in self.instruction_list[index:index + 2]
-                             if ins.op_code == "PUSH4")
-                selector = push4.argument
+                selector_push = self.instruction_list[index + selector_offset]
+                selector = selector_push.argument
                 if selector is None:
                     continue
                 selector = "0x" + selector[2:].rjust(8, "0")

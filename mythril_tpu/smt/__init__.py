@@ -1,0 +1,59 @@
+"""Public SMT facade (API parity: mythril/laser/smt/__init__.py:1-30).
+
+Everything above this layer (state model, instructions, detectors) creates symbols
+through `symbol_factory` and never touches the term IR directly — the same designed
+seam the reference uses to host alternative backends (its `_SmtSymbolFactory` vs
+`_Z3SymbolFactory`). Here the seam is where the CDCL (host) and JAX (TPU) solver
+backends plug in.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from . import terms
+from .expression import Expression, simplify
+from .bitvec import (
+    BitVec, UGT, UGE, ULT, ULE, SGT, SLT, UDiv, URem, SRem, SDiv, LShR,
+    Concat, Extract, ZeroExt, SignExt, If, Sum,
+    BVAddNoOverflow, BVMulNoOverflow, BVSubNoUnderflow,
+)
+from .bool import Bool, And, Or, Not, Xor, Implies
+from .array import Array, BaseArray, K
+from .function import Function
+from .model import Model
+from .solver.solver import BaseSolver, Solver, Optimize
+from .solver.independence_solver import IndependenceSolver
+
+
+class SymbolFactory:
+    """All symbol creation funnels through here (reference smt/__init__.py:36-154)."""
+
+    @staticmethod
+    def BitVecVal(value: int, size: int, annotations: Optional[Set] = None) -> BitVec:
+        return BitVec(terms.bv_const(value, size), annotations)
+
+    @staticmethod
+    def BitVecSym(name: str, size: int, annotations: Optional[Set] = None) -> BitVec:
+        return BitVec(terms.bv_var(name, size), annotations)
+
+    @staticmethod
+    def BoolVal(value: bool, annotations: Optional[Set] = None) -> Bool:
+        return Bool(terms.bool_const(value), annotations)
+
+    @staticmethod
+    def BoolSym(name: str, annotations: Optional[Set] = None) -> Bool:
+        return Bool(terms.bool_var(name), annotations)
+
+
+symbol_factory = SymbolFactory()
+
+__all__ = [
+    "terms", "Expression", "simplify", "BitVec", "Bool", "Array", "BaseArray", "K",
+    "Function", "Model", "BaseSolver", "Solver", "Optimize", "IndependenceSolver",
+    "symbol_factory", "SymbolFactory",
+    "UGT", "UGE", "ULT", "ULE", "SGT", "SLT", "UDiv", "URem", "SRem", "SDiv", "LShR",
+    "Concat", "Extract", "ZeroExt", "SignExt", "If", "Sum",
+    "BVAddNoOverflow", "BVMulNoOverflow", "BVSubNoUnderflow",
+    "And", "Or", "Not", "Xor", "Implies",
+]

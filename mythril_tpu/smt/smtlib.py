@@ -1,0 +1,92 @@
+"""SMT-LIB2 printer for the term IR.
+
+Role parity: the reference's `--solver-log` dumps every query as .smt2
+(mythril/support/model.py:51-61); that corpus is the differential-testing referee
+between this build's solver and any external SMT solver the user runs offline."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from . import terms
+
+
+def _sort_str(sort) -> str:
+    if sort == terms.BOOL:
+        return "Bool"
+    if isinstance(sort, terms.ArraySort):
+        return f"(Array (_ BitVec {sort.index_width}) (_ BitVec {sort.value_width}))"
+    return f"(_ BitVec {sort})"
+
+
+_OP_MAP = {
+    "bvadd": "bvadd", "bvsub": "bvsub", "bvmul": "bvmul", "bvudiv": "bvudiv",
+    "bvsdiv": "bvsdiv", "bvurem": "bvurem", "bvsrem": "bvsrem", "bvand": "bvand",
+    "bvor": "bvor", "bvxor": "bvxor", "bvshl": "bvshl", "bvlshr": "bvlshr",
+    "bvashr": "bvashr", "bvnot": "bvnot", "bvult": "bvult", "bvule": "bvule",
+    "bvslt": "bvslt", "bvsle": "bvsle", "eq": "=", "and": "and", "or": "or",
+    "not": "not", "xor": "xor", "ite": "ite", "select": "select", "store": "store",
+    "concat": "concat",
+}
+
+
+def _mangle(name: str) -> str:
+    safe = "".join(ch if ch.isalnum() or ch in "_.$" else "_" for ch in str(name))
+    return f"|{name}|" if safe != str(name) else safe
+
+
+def term_to_smt2(node: terms.Term, cache: Dict[terms.Term, str]) -> str:
+    hit = cache.get(node)
+    if hit is not None:
+        return hit
+    op = node.op
+    if op == "const":
+        if node.sort == terms.BOOL:
+            text = "true" if node.params[0] else "false"
+        else:
+            text = f"(_ bv{node.params[0]} {node.sort})"
+    elif op == "var":
+        text = _mangle(node.params[0])
+    elif op == "extract":
+        text = f"((_ extract {node.params[0]} {node.params[1]}) " \
+               f"{term_to_smt2(node.args[0], cache)})"
+    elif op == "zext":
+        text = f"((_ zero_extend {node.params[0]}) {term_to_smt2(node.args[0], cache)})"
+    elif op == "sext":
+        text = f"((_ sign_extend {node.params[0]}) {term_to_smt2(node.args[0], cache)})"
+    elif op == "const_array":
+        text = f"((as const {_sort_str(node.sort)}) {term_to_smt2(node.args[0], cache)})"
+    elif op == "apply":
+        inner = " ".join(term_to_smt2(a, cache) for a in node.args)
+        text = f"({_mangle(node.params[0])} {inner})"
+    else:
+        mapped = _OP_MAP.get(op)
+        if mapped is None:
+            raise ValueError(f"cannot print op {op}")
+        inner = " ".join(term_to_smt2(a, cache) for a in node.args)
+        text = f"({mapped} {inner})"
+    cache[node] = text
+    return text
+
+
+def to_smt2(constraints: List[terms.Term]) -> str:
+    declarations = {}
+    ufs = {}
+    for constraint in constraints:
+        for node in terms.walk(constraint):
+            if node.op == "var":
+                declarations[node.params[0]] = node.sort
+            elif node.op == "apply":
+                ufs[node.params[0]] = (node.params[1], node.params[2])
+    lines = ["(set-logic QF_AUFBV)"]
+    for name, sort in sorted(declarations.items()):
+        lines.append(f"(declare-fun {_mangle(name)} () {_sort_str(sort)})")
+    for name, (domain, range_width) in sorted(ufs.items()):
+        domain_str = " ".join(f"(_ BitVec {w})" for w in domain)
+        lines.append(f"(declare-fun {_mangle(name)} ({domain_str}) "
+                     f"(_ BitVec {range_width}))")
+    cache: Dict[terms.Term, str] = {}
+    for constraint in constraints:
+        lines.append(f"(assert {term_to_smt2(constraint, cache)})")
+    lines.append("(check-sat)")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,123 @@
+"""SAT backend bindings: native CDCL (native/cdcl.cpp via ctypes) with a pure-Python
+DPLL fallback so the framework works without the native build (the fallback is only
+suitable for small instances; build native/ for real workloads)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Tuple
+
+SAT, UNSAT, UNKNOWN = 1, 0, -1
+
+_lib = None
+_lib_checked = False
+
+
+def _load_lib():
+    global _lib, _lib_checked
+    if _lib_checked:
+        return _lib
+    _lib_checked = True
+    path = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                                        "native", "build", "libmythril_native.so"))
+    if os.path.exists(path):
+        try:
+            lib = ctypes.CDLL(path)
+            lib.mtpu_solve.argtypes = [ctypes.POINTER(ctypes.c_int32), ctypes.c_size_t,
+                                       ctypes.c_int32, ctypes.c_int64, ctypes.c_char_p]
+            lib.mtpu_solve.restype = ctypes.c_int
+            _lib = lib
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def solve_cnf(clauses: List[List[int]], n_vars: int,
+              max_conflicts: int = 2_000_000) -> Tuple[int, Optional[List[bool]]]:
+    """Returns (status, model). model[v-1] is the boolean for DIMACS var v on SAT."""
+    lib = _load_lib()
+    if lib is not None:
+        total = sum(len(c) + 1 for c in clauses)
+        flat = (ctypes.c_int32 * total)()
+        pos = 0
+        for clause in clauses:
+            for lit in clause:
+                flat[pos] = lit
+                pos += 1
+            flat[pos] = 0
+            pos += 1
+        model_buf = ctypes.create_string_buffer(max(1, n_vars))
+        status = lib.mtpu_solve(flat, total, n_vars, max_conflicts, model_buf)
+        if status == SAT:
+            return SAT, [model_buf.raw[v] == 1 for v in range(n_vars)]
+        return status, None
+    return _python_dpll(clauses, n_vars, max_conflicts)
+
+
+def _python_dpll(clauses: List[List[int]], n_vars: int,
+                 budget: int) -> Tuple[int, Optional[List[bool]]]:
+    """Minimal iterative DPLL with unit propagation (fallback only)."""
+    assign: dict = {}
+    trail: List[List[int]] = []
+
+    def value(lit: int):
+        v = assign.get(abs(lit))
+        if v is None:
+            return None
+        return v if lit > 0 else not v
+
+    def propagate() -> bool:
+        changed = True
+        while changed:
+            changed = False
+            for clause in clauses:
+                unassigned = None
+                satisfied = False
+                count = 0
+                for lit in clause:
+                    val = value(lit)
+                    if val is True:
+                        satisfied = True
+                        break
+                    if val is None:
+                        unassigned = lit
+                        count += 1
+                if satisfied:
+                    continue
+                if count == 0:
+                    return False
+                if count == 1:
+                    assign[abs(unassigned)] = unassigned > 0
+                    trail[-1].append(abs(unassigned))
+                    changed = True
+        return True
+
+    trail.append([])
+    decisions: List[Tuple[int, bool]] = []
+    steps = 0
+    while True:
+        steps += 1
+        if steps > budget:
+            return UNKNOWN, None
+        if propagate():
+            free = next((v for v in range(1, n_vars + 1) if v not in assign), None)
+            if free is None:
+                return SAT, [assign.get(v, False) for v in range(1, n_vars + 1)]
+            decisions.append((free, False))
+            trail.append([])
+            assign[free] = True
+            trail[-1].append(free)
+        else:
+            while decisions:
+                var, tried_both = decisions.pop()
+                for v in trail.pop():
+                    assign.pop(v, None)
+                if not tried_both:
+                    decisions.append((var, True))
+                    trail.append([])
+                    assign[var] = False
+                    trail[-1].append(var)
+                    break
+            else:
+                return UNSAT, None

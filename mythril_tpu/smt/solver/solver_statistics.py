@@ -1,0 +1,45 @@
+"""Per-process solver query statistics (API parity:
+mythril/laser/smt/solver/solver_statistics.py:29 + stat_smt_query:8)."""
+
+from __future__ import annotations
+
+import time
+from functools import wraps
+
+
+class SolverStatistics:
+    """Singleton: query count + cumulative wall time, printed per contract."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enabled = False
+            cls._instance.query_count = 0
+            cls._instance.solver_time = 0.0
+        return cls._instance
+
+    def reset(self) -> None:
+        self.query_count = 0
+        self.solver_time = 0.0
+
+    def __repr__(self):
+        return (f"Solver statistics: query count: {self.query_count}, "
+                f"solver time: {self.solver_time:.3f}s")
+
+
+def stat_smt_query(func):
+    """Times every solver check() (decorator parity with the reference)."""
+
+    @wraps(func)
+    def wrapper(*args, **kwargs):
+        statistics = SolverStatistics()
+        statistics.query_count += 1
+        started = time.time()
+        try:
+            return func(*args, **kwargs)
+        finally:
+            statistics.solver_time += time.time() - started
+
+    return wrapper

@@ -44,7 +44,7 @@ class SignatureDB:
     _instance = None
     _lock = threading.Lock()
 
-    def __new__(cls, enable_online_lookup: bool = False, path: str | None = None):
+    def __new__(cls, enable_online_lookup: bool | None = None, path: str | None = None):
         if path is None:
             with cls._lock:
                 if cls._instance is None:
@@ -52,11 +52,13 @@ class SignatureDB:
                 return cls._instance
         return super().__new__(cls)
 
-    def __init__(self, enable_online_lookup: bool = False, path: str | None = None):
+    def __init__(self, enable_online_lookup: bool | None = None, path: str | None = None):
         if getattr(self, "_initialized", False) and path is None:
-            self.enable_online_lookup = enable_online_lookup
+            # singleton re-construction: only an EXPLICIT flag changes the setting
+            if enable_online_lookup is not None:
+                self.enable_online_lookup = enable_online_lookup
             return
-        self.enable_online_lookup = enable_online_lookup
+        self.enable_online_lookup = bool(enable_online_lookup)
         self.path = path or _default_db_path()
         self._local = threading.local()
         self._ensure_schema()

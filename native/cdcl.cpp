@@ -64,7 +64,6 @@ class Solver {
 
   // 1 SAT, 0 UNSAT, -1 budget exceeded
   int solve(int64_t max_conflicts) {
-    if (unsat_) return 0;
     if (propagate() != -1) return 0;  // top-level conflict
     int64_t conflicts = 0;
     int64_t restart_limit = luby(restart_count_) * 128;
@@ -351,7 +350,6 @@ class Solver {
   }
 
   int n_vars_;
-  bool unsat_ = false;
   std::vector<Clause> clauses_;
   std::vector<LBool> assign_;
   std::vector<uint8_t> phase_;
@@ -388,6 +386,8 @@ extern "C" int mtpu_solve(const int32_t* lits, size_t n_lits, int32_t n_vars,
       clause.push_back(mk_lit(var, l < 0));
     }
   }
+  // flush a trailing clause missing its 0 terminator rather than dropping it
+  if (ok && !clause.empty()) ok = solver.add_clause(clause);
   if (!ok) return 0;
   int result = solver.solve(max_conflicts);
   if (result == 1 && model_out) {
