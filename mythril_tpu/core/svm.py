@@ -1,0 +1,441 @@
+"""LaserEVM: the worklist symbolic executor (API parity:
+mythril/laser/ethereum/svm.py — LaserEVM:43, sym_exec:151, execute_transactions:220,
+exec:325, execute_state:401, _end_message_call:525, manage_cfg:581, and the
+11 lifecycle hook types + per-opcode pre/post hooks).
+
+This is the host/oracle engine: one state at a time, exact semantics. The TPU
+engine (parallel/) steps thousands of lanes in lockstep against the same
+instruction semantics; `--engine tpu` routes exploration there with this engine as
+the semantic referee."""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from copy import copy
+from datetime import datetime, timedelta
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+from ..exceptions import UnsatError
+from ..smt import Bool, symbol_factory
+from ..support.model import get_model
+from .instructions import Instruction, transfer_ether
+from .node import Edge, JumpType, Node, NodeFlags
+from .plugin.signals import PluginSkipState, PluginSkipWorldState
+from .state.global_state import GlobalState
+from .state.world_state import WorldState
+from .strategy.basic import BasicSearchStrategy, DepthFirstSearchStrategy
+from .time_handler import time_handler
+from .transaction import (ContractCreationTransaction, MessageCallTransaction,
+                          TransactionEndSignal, TransactionStartSignal,
+                          execute_contract_creation, execute_message_call)
+from .transaction.transaction_models import BaseTransaction, tx_id_manager
+from .util import VmException
+from .state.machine_state import StackUnderflowException
+from ..ops.opcodes import OPCODES, STACK
+
+log = logging.getLogger(__name__)
+
+
+class SVMError(Exception):
+    pass
+
+
+class LaserEVM:
+    """Worklist symbolic virtual machine."""
+
+    def __init__(self, dynamic_loader=None, max_depth: int = 128,
+                 execution_timeout: Optional[int] = 60,
+                 create_timeout: Optional[int] = 10,
+                 strategy=DepthFirstSearchStrategy,
+                 transaction_count: int = 2,
+                 requires_statespace: bool = True,
+                 iprof=None, use_reachability_check: bool = True,
+                 beam_width: Optional[int] = None,
+                 tx_strategy: Optional[str] = None,
+                 pruning_factor: Optional[float] = None):
+        self.dynamic_loader = dynamic_loader
+        self.open_states: List[WorldState] = []
+        self.total_states = 0
+
+        self.work_list: List[GlobalState] = []
+        self.strategy: BasicSearchStrategy = strategy(
+            self.work_list, max_depth, beam_width=beam_width)
+        self.max_depth = max_depth
+        self.transaction_count = transaction_count
+        self.executed_transactions = False
+        self.tx_strategy = tx_strategy
+
+        self.execution_timeout = execution_timeout or 0
+        self.create_timeout = create_timeout or 0
+        self.use_reachability_check = use_reachability_check
+        self.pruning_factor = pruning_factor
+
+        self.requires_statespace = requires_statespace
+        self.nodes: Dict[int, Node] = {}
+        self.edges: List[Edge] = []
+
+        self.time: Optional[datetime] = None
+        self.executed_nodes = 0
+
+        self.iprof = iprof
+        self.instr_pre_hook: Dict[str, List[Callable]] = defaultdict(list)
+        self.instr_post_hook: Dict[str, List[Callable]] = defaultdict(list)
+
+        # lifecycle hooks (the 11 hook types of the reference, svm.py:107-145)
+        self._add_world_state_hooks: List[Callable] = []
+        self._execute_state_hooks: List[Callable] = []
+        self._start_exec_hooks: List[Callable] = []
+        self._stop_exec_hooks: List[Callable] = []
+        self._start_sym_trans_hooks: List[Callable] = []
+        self._stop_sym_trans_hooks: List[Callable] = []
+        self._start_sym_exec_hooks: List[Callable] = []
+        self._stop_sym_exec_hooks: List[Callable] = []
+        self._transaction_end_hooks: List[Callable] = []
+
+    # -- strategy wrapping ------------------------------------------------------------
+    def extend_strategy(self, extension: type, **kwargs) -> None:
+        self.strategy = extension(self.strategy, **kwargs)
+
+    # -- entry points ----------------------------------------------------------------
+    def sym_exec(self, world_state: Optional[WorldState] = None,
+                 target_address: Optional[int] = None,
+                 creation_code: Optional[str] = None,
+                 contract_name: Optional[str] = None) -> None:
+        """Symbolically execute: either from an existing world state + target, or a
+        creation transaction from scratch."""
+        pre_configuration_mode = world_state is not None and target_address is not None
+        scratch_mode = creation_code is not None and contract_name is not None
+        if pre_configuration_mode == scratch_mode:
+            raise SVMError("need exactly one of (world_state, target) | creation code")
+
+        self._start_time = datetime.now()
+        for hook in self._start_sym_exec_hooks:
+            hook()
+
+        if pre_configuration_mode:
+            self.open_states = [world_state]
+            log.info("starting message call transaction to %s", hex(target_address))
+            self.execute_transactions(symbol_factory.BitVecVal(target_address, 256))
+        else:
+            log.info("starting contract creation transaction")
+            self.time = datetime.now()
+            time_handler.start_execution(self.create_timeout or self.execution_timeout)
+            created_account = execute_contract_creation(
+                self, creation_code, contract_name)
+            log.info("finished contract creation, found %d open states",
+                     len(self.open_states))
+            if not self.open_states:
+                log.warning("no contract was created during the creation transaction")
+            self.execute_transactions(created_account.address)
+
+        for hook in self._stop_sym_exec_hooks:
+            hook()
+
+    def execute_transactions(self, address) -> None:
+        """Drive `transaction_count` message-call transactions (reference svm.py:220)."""
+        self.executed_transactions = True
+        time_handler.start_execution(self.execution_timeout)
+        self.time = datetime.now()
+        for i in range(self.transaction_count):
+            if len(self.open_states) == 0:
+                log.info("no open states left, ending transaction sequence")
+                break
+            old_states_count = len(self.open_states)
+            if self.use_reachability_check:
+                self.open_states = [
+                    state for state in self.open_states
+                    if state.constraints.is_possible()]
+                prune_count = old_states_count - len(self.open_states)
+                if prune_count:
+                    log.info("pruned %d unreachable states", prune_count)
+            log.info("starting message call transaction, iteration: %d, "
+                     "%d initial states", i, len(self.open_states))
+            for hook in self._start_sym_trans_hooks:
+                hook()
+            execute_message_call(self, address)
+            for hook in self._stop_sym_trans_hooks:
+                hook()
+
+    # -- main loop --------------------------------------------------------------------
+    def exec(self, create: bool = False, track_gas: bool = False) -> Optional[List[GlobalState]]:
+        final_states: List[GlobalState] = []
+        for global_state in self.strategy:
+            if create and self.create_timeout and \
+                    self.time + timedelta(seconds=self.create_timeout) <= datetime.now():
+                log.debug("hit create timeout, returning")
+                return final_states + self.work_list if track_gas else None
+            if not create and self.execution_timeout and \
+                    self.time + timedelta(seconds=self.execution_timeout) <= datetime.now():
+                log.debug("hit execution timeout, returning")
+                return final_states + self.work_list if track_gas else None
+
+            try:
+                new_states, op_code = self.execute_state(global_state)
+            except NotImplementedError:
+                log.debug("encountered unimplemented instruction")
+                continue
+
+            if self.pruning_factor is not None and new_states:
+                import random
+
+                if random.random() > self.pruning_factor:
+                    # stochastic mid-run feasibility pruning (reference svm.py:351-358)
+                    new_states = [
+                        state for state in new_states
+                        if state.world_state.constraints.is_possible()]
+
+            if self.requires_statespace:
+                self.manage_cfg(op_code, new_states)
+            self.work_list.extend(new_states)
+            if not new_states and track_gas:
+                final_states.append(global_state)
+            self.total_states += len(new_states)
+        return final_states if track_gas else None
+
+    def execute_state(self, global_state: GlobalState
+                      ) -> Tuple[List[GlobalState], Optional[str]]:
+        """Execute one instruction on one state (reference svm.py:401)."""
+        instructions = global_state.environment.code.instruction_list
+        try:
+            op_code = instructions[global_state.mstate.pc].op_code
+        except IndexError:
+            op_code = "STOP"  # running off code end halts (and unwinds call frames)
+        global_state.op_code = op_code
+
+        try:
+            for hook in self._execute_state_hooks:
+                hook(global_state)
+        except PluginSkipState:
+            self._add_world_state(global_state)
+            return [], None
+
+        # stack preflight (reference svm.py:423-434)
+        meta = OPCODES.get(op_code)
+        if meta is not None and len(global_state.mstate.stack) < meta[STACK][0]:
+            error_state = copy(global_state)
+            self._handle_vm_exception(
+                error_state, op_code,
+                StackUnderflowException(f"{op_code} needs {meta[STACK][0]} stack items"))
+            return [], op_code
+
+        try:
+            new_global_states = Instruction(
+                op_code, self.dynamic_loader,
+                pre_hooks=self.instr_pre_hook[op_code],
+                post_hooks=self.instr_post_hook[op_code],
+            ).evaluate(global_state)
+
+        except VmException as error:
+            error_state = copy(global_state)
+            self._handle_vm_exception(error_state, op_code, error)
+            new_global_states = []
+
+        except StackUnderflowException as error:
+            error_state = copy(global_state)
+            self._handle_vm_exception(error_state, op_code, error)
+            new_global_states = []
+
+        except TransactionStartSignal as start_signal:
+            # open a nested call frame (reference svm.py:459-473)
+            parent_state = start_signal.global_state
+            new_global_state = start_signal.transaction.initial_global_state()
+            new_global_state.transaction_stack = (
+                list(parent_state.transaction_stack)
+                + [(start_signal.transaction, parent_state)])
+            new_global_state.node = global_state.node
+            new_global_state.world_state.transient_storage.checkpoint()
+            new_global_state.mstate.depth = parent_state.mstate.depth
+            log.debug("starting nested %s transaction", start_signal.op_code)
+            return [new_global_state], op_code
+
+        except TransactionEndSignal as end_signal:
+            transaction, return_global_state = \
+                end_signal.global_state.transaction_stack[-1]
+
+            for hook in self._transaction_end_hooks:
+                hook(end_signal.global_state, transaction, return_global_state,
+                     end_signal.revert)
+
+            if return_global_state is None:
+                # outermost transaction ends
+                if (not isinstance(transaction, ContractCreationTransaction)
+                        or transaction.return_data) and not end_signal.revert:
+                    end_signal.global_state.world_state.node = global_state.node
+                    self._add_world_state(end_signal.global_state)
+                new_global_states = []
+            else:
+                # nested call returns to caller frame (reference svm.py:525)
+                new_global_states = self._end_message_call(
+                    end_signal, transaction, return_global_state)
+
+        self.executed_nodes += 1
+        for state in new_global_states:
+            state.mstate.depth += 1
+        return new_global_states, op_code
+
+    def _end_message_call(self, end_signal: TransactionEndSignal,
+                          transaction: BaseTransaction,
+                          return_global_state: GlobalState) -> List[GlobalState]:
+        return_global_state = copy(return_global_state)
+        # adopt the callee world state unless reverted
+        if not end_signal.revert:
+            return_global_state.world_state = end_signal.global_state.world_state
+            return_global_state.environment.active_account = \
+                end_signal.global_state.world_state.accounts[
+                    return_global_state.environment.active_account.address.raw.value]
+            return_global_state.world_state.transient_storage.commit()
+        else:
+            return_global_state.world_state.transient_storage.rollback()
+            transaction.return_data = None
+
+        return_global_state.last_return_data = transaction.return_data
+
+        # rerun the calling instruction's post-handler
+        op_code = return_global_state.get_current_instruction()["opcode"]
+        try:
+            new_global_states = Instruction(
+                op_code, self.dynamic_loader).evaluate(return_global_state, post=True)
+        except VmException as error:
+            self._handle_vm_exception(return_global_state, op_code, error)
+            new_global_states = []
+        return new_global_states
+
+    def _handle_vm_exception(self, global_state: GlobalState, op_code: str,
+                             error) -> None:
+        """Path terminates with an exception: revert frame or record world state
+        (reference svm.py:382-399)."""
+        transaction, return_global_state = global_state.transaction_stack[-1]
+        log.debug("%s at pc %d: %s", type(error).__name__,
+                  global_state.mstate.pc, error)
+        if return_global_state is None:
+            # outermost frame: the tx fails, world state not persisted
+            return
+        # nested frame fails: caller sees retval 0
+        try:
+            transaction.return_data = None
+            end_signal = TransactionEndSignal(global_state, revert=True)
+            new_states = self._end_message_call(end_signal, transaction,
+                                                return_global_state)
+            self.work_list.extend(new_states)
+        except Exception:
+            log.debug("error unwinding failed call frame", exc_info=True)
+
+    def _add_world_state(self, global_state: GlobalState) -> None:
+        """Record a post-transaction open world state (reference svm.py:_add_world_state)."""
+        try:
+            for hook in self._add_world_state_hooks:
+                hook(global_state)
+        except PluginSkipWorldState:
+            return
+        self.open_states.append(global_state.world_state)
+
+    # -- CFG --------------------------------------------------------------------------
+    def new_node_for_transaction(self, global_state: GlobalState,
+                                 transaction: BaseTransaction) -> None:
+        new_node = Node(global_state.environment.active_account.contract_name)
+        self.nodes[new_node.uid] = new_node
+        if getattr(transaction.world_state, "node", None):
+            self.edges.append(Edge(transaction.world_state.node.uid, new_node.uid,
+                                   edge_type=JumpType.Transaction, condition=None))
+        global_state.node = new_node
+        new_node.states.append(global_state)
+
+    def manage_cfg(self, opcode: Optional[str], new_states: List[GlobalState]) -> None:
+        """Maintain nodes/edges (reference svm.py:581)."""
+        if opcode is None:
+            return
+        if opcode == "JUMP":
+            for state in new_states:
+                self._new_node_state(state, JumpType.UNCONDITIONAL)
+        elif opcode == "JUMPI":
+            for state in new_states:
+                condition = state.world_state.constraints[-1] \
+                    if state.world_state.constraints else None
+                self._new_node_state(state, JumpType.CONDITIONAL, condition)
+        elif opcode in ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL",
+                        "CREATE", "CREATE2"):
+            for state in new_states:
+                self._new_node_state(state, JumpType.CALL)
+        elif opcode in ("RETURN", "STOP", "REVERT"):
+            for state in new_states:
+                self._new_node_state(state, JumpType.RETURN)
+        for state in new_states:
+            if state.node:
+                state.node.states.append(state)
+
+    def _new_node_state(self, state: GlobalState,
+                        edge_type: JumpType = JumpType.UNCONDITIONAL,
+                        condition=None) -> None:
+        try:
+            address = state.environment.code.instruction_list[state.mstate.pc].address
+        except IndexError:
+            return
+        new_node = Node(state.environment.active_account.contract_name,
+                        start_addr=address)
+        old_node = state.node
+        state.node = new_node
+        new_node.constraints = list(state.world_state.constraints)
+        self.nodes[new_node.uid] = new_node
+        if old_node:
+            self.edges.append(Edge(old_node.uid, new_node.uid, edge_type, condition))
+
+        if edge_type == JumpType.RETURN:
+            new_node.flags.append(NodeFlags.CALL_RETURN)
+
+        environment = state.environment
+        disassembly = environment.code
+        if address in disassembly.address_to_function_name:
+            new_node.flags.append(NodeFlags.FUNC_ENTRY)
+            environment.active_function_name = \
+                disassembly.address_to_function_name[address]
+        new_node.function_name = getattr(environment, "active_function_name",
+                                         "unknown")
+
+    # -- hook registration (parity with svm.py:669-741) --------------------------------
+    def register_hooks(self, hook_type: str,
+                       hook_dict: Dict[str, List[Callable]]) -> None:
+        registry = self.instr_pre_hook if hook_type == "pre" else self.instr_post_hook
+        for op_code, funcs in hook_dict.items():
+            registry[op_code].extend(funcs)
+
+    def register_laser_hooks(self, hook_type: str, hook: Callable) -> None:
+        mapping = {
+            "add_world_state": self._add_world_state_hooks,
+            "execute_state": self._execute_state_hooks,
+            "start_exec": self._start_exec_hooks,
+            "stop_exec": self._stop_exec_hooks,
+            "start_sym_exec": self._start_sym_exec_hooks,
+            "stop_sym_exec": self._stop_sym_exec_hooks,
+            "start_sym_trans": self._start_sym_trans_hooks,
+            "stop_sym_trans": self._stop_sym_trans_hooks,
+            "transaction_end": self._transaction_end_hooks,
+        }
+        if hook_type not in mapping:
+            raise ValueError(f"invalid hook type {hook_type}")
+        mapping[hook_type].append(hook)
+
+    def register_instr_hooks(self, hook_type: str, op_code: str, hook: Callable) -> None:
+        registry = self.instr_pre_hook if hook_type == "pre" else self.instr_post_hook
+        if not op_code:
+            for op in OPCODES:
+                registry[op].append(hook)
+        else:
+            registry[op_code].append(hook)
+
+    def instr_hook(self, hook_type: str, op_code: str) -> Callable:
+        def hook_decorator(function: Callable) -> Callable:
+            self.register_instr_hooks(hook_type, op_code, function)
+            return function
+
+        return hook_decorator
+
+    def laser_hook(self, hook_type: str) -> Callable:
+        def hook_decorator(function: Callable) -> Callable:
+            self.register_laser_hooks(hook_type, function)
+            return function
+
+        return hook_decorator
+
+    def hook(self, op_code: str) -> Callable:
+        return self.instr_hook("pre", op_code)

@@ -39,6 +39,15 @@ class Expression(Generic[T]):
     def symbolic(self) -> bool:
         return not self.raw.is_const
 
+    def __copy__(self):
+        clone = type(self).__new__(type(self))
+        Expression.__init__(clone, self.raw, self._annotations)
+        return clone
+
+    def __deepcopy__(self, memo):
+        # terms are immutable + hash-consed: a deep copy must NOT rebuild the graph
+        return self.__copy__()
+
     def __repr__(self):
         return repr(self.raw)
 

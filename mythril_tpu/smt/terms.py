@@ -14,6 +14,7 @@ Uninterpreted functions are applications tagged with (name, signature).
 from __future__ import annotations
 
 import itertools
+import weakref
 from typing import Dict, Iterable, Optional, Tuple
 
 # ---------------------------------------------------------------------------------
@@ -70,7 +71,12 @@ class Term:
 
     __slots__ = ("op", "args", "params", "sort", "_hash", "__weakref__")
 
-    _interned: Dict[tuple, "Term"] = {}
+    # Weak interning: entries die with their last strong reference, so a long
+    # multi-contract run doesn't accumulate every expression ever built (the
+    # z3-backed reference gets this from AST refcounting). id()-based keys are
+    # sound here: a live parent holds its children strongly, so the ids inside a
+    # live key cannot be recycled.
+    _interned: "weakref.WeakValueDictionary[tuple, Term]" = None  # set below
     _counter = itertools.count()
 
     def __new__(cls, op: str, args: Tuple["Term", ...] = (), params: tuple = (),
@@ -84,7 +90,7 @@ class Term:
         term.args = args
         term.params = params
         term.sort = sort
-        term._hash = hash((op, tuple(id(a) for a in args), params, _sort_key(sort)))
+        term._hash = hash(key)
         cls._interned[key] = term
         return term
 
@@ -94,6 +100,12 @@ class Term:
     # identity equality is correct under hash-consing
     def __eq__(self, other):
         return self is other
+
+    def __copy__(self):
+        return self
+
+    def __deepcopy__(self, memo):
+        return self
 
     @property
     def is_const(self) -> bool:
@@ -119,6 +131,9 @@ class Term:
 
     def __repr__(self):
         return _pp(self, depth=3)
+
+
+Term._interned = weakref.WeakValueDictionary()
 
 
 def _sort_key(sort):
@@ -476,11 +491,11 @@ def select(array: Term, index: Term) -> Term:
             node = node.args[0]  # definitely different concrete cells
             continue
         break  # possibly aliasing symbolic index: keep the select symbolic
-    if node.op == "const_array" and (node is array or array.op != "store"):
+    if node.op == "const_array":
+        # every skipped store was provably non-aliasing: the read hits the default
         return node.args[0]
-    if array.op == "const_array":
-        return array.args[0]
-    return Term("select", (array, index), (), sort.value_width)
+    # prune the provably non-aliasing prefix of the chain
+    return Term("select", (node, index), (), sort.value_width)
 
 
 # -- uninterpreted functions ------------------------------------------------------

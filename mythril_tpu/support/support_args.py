@@ -1,0 +1,43 @@
+"""Global engine flags (API parity: mythril/support/support_args.py:5).
+
+The reference copies argparse values wholesale into this singleton and reads it from
+arbitrary depths. Kept for CLI/capability parity, but engine components snapshot the
+values they need at construction so nothing inside a jitted TPU step reads mutable
+globals (SURVEY.md §5 config note)."""
+
+from __future__ import annotations
+
+
+class Args:
+    """Singleton flag object."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init_defaults()
+        return cls._instance
+
+    def _init_defaults(self):
+        self.solver_log = None
+        self.transaction_sequences = None
+        self.use_integer_module = True
+        self.use_issue_annotations = False
+        self.solver_timeout = 10000
+        self.parallel_solving = False
+        self.unconstrained_storage = False
+        self.call_depth_limit = 3
+        self.disable_iprof = True
+        self.solc_args = None
+        self.disable_coverage_strategy = False
+        self.disable_mutation_pruner = False
+        self.incremental_txs = True
+        self.epic = False
+        self.pruning_factor = None
+        #: solver backend: "cdcl" (native host solver) or "jax" (batched TPU solver)
+        self.solver = "cdcl"
+        self.sparse_pruning = True
+
+
+args = Args()

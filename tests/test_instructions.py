@@ -1,0 +1,249 @@
+"""Per-opcode unit tests (test-strategy parity: reference tests/instructions/*):
+hand-built GlobalState, call Instruction(op).evaluate directly, assert
+stack/memory/exception effects."""
+
+import pytest
+
+from mythril_tpu.core.instructions import Instruction
+from mythril_tpu.core.state import (Account, Environment, GlobalState,
+                                    MachineState, WorldState)
+from mythril_tpu.core.state.calldata import ConcreteCalldata
+from mythril_tpu.core.transaction.transaction_models import MessageCallTransaction
+from mythril_tpu.core.util import InvalidInstruction, WriteProtection
+from mythril_tpu.frontends.disassembler import Disassembly
+from mythril_tpu.smt import symbol_factory
+
+
+def make_state(code_hex: str = "", static: bool = False,
+               calldata=None) -> GlobalState:
+    world_state = WorldState()
+    account = world_state.create_account(balance=10 ** 18, address=0x1AAF)
+    account.code = Disassembly(code_hex or "0x60")
+    environment = Environment(
+        active_account=account,
+        sender=symbol_factory.BitVecVal(0xCAFE, 256),
+        calldata=calldata or ConcreteCalldata("1", []),
+        gasprice=symbol_factory.BitVecVal(1, 256),
+        callvalue=symbol_factory.BitVecVal(0, 256),
+        origin=symbol_factory.BitVecVal(0xCAFE, 256),
+        basefee=symbol_factory.BitVecVal(7, 256),
+        static=static,
+    )
+    state = GlobalState(world_state, environment, None,
+                        MachineState(gas_limit=8000000))
+    transaction = MessageCallTransaction(
+        world_state=world_state, callee_account=account,
+        caller=environment.sender, identifier="1", gas_limit=8000000)
+    state.transaction_stack.append((transaction, None))
+    return state
+
+
+def push(state, *values):
+    for value in values:
+        state.mstate.stack.append(symbol_factory.BitVecVal(value, 256))
+
+
+def run(state, op):
+    return Instruction(op).evaluate(state)
+
+
+M = 2 ** 256
+
+
+@pytest.mark.parametrize("op,inputs,expected", [
+    ("ADD", [3, 5], (3 + 5)),
+    ("ADD", [M - 1, 2], 1),
+    ("SUB", [3, 5], 5 - 3 + M),     # stack top is first operand
+    ("MUL", [7, 9], 63),
+    ("DIV", [2, 10], 5),
+    ("DIV", [0, 10], 0),
+    ("SDIV", [M - 2, 10], M - 5),    # 10 / -2 = -5
+    ("MOD", [3, 10], 1),
+    ("MOD", [0, 10], 0),
+    ("SMOD", [3, M - 10], M - 1),    # -10 smod 3 = -1
+    ("ADDMOD", [5, M - 1, M - 1], (((M - 1) + (M - 1)) % 5)),
+    ("MULMOD", [5, M - 1, M - 1], (((M - 1) * (M - 1)) % 5)),
+    ("EXP", [3, 2], 8),
+    ("SIGNEXTEND", [0xFF, 0], M - 1),   # stack: value below, byte-index on top
+    ("SIGNEXTEND", [0x7F, 0], 0x7F),
+    ("LT", [5, 3], 1),
+    ("GT", [5, 3], 0),
+    ("SLT", [1, M - 1], 1),          # -1 < 1
+    ("SGT", [1, M - 1], 0),
+    ("EQ", [4, 4], 1),
+    ("ISZERO", [0], 1),
+    ("AND", [0b1100, 0b1010], 0b1000),
+    ("OR", [0b1100, 0b1010], 0b1110),
+    ("XOR", [0b1100, 0b1010], 0b0110),
+    ("NOT", [0], M - 1),
+    ("BYTE", [0xAABB, 31], 0xBB),
+    ("BYTE", [0xAABB, 30], 0xAA),
+    ("BYTE", [0xAABB, 32], 0),
+    ("SHL", [1, 4], 16),
+    ("SHR", [16, 4], 1),
+    ("SAR", [M - 16, 4], M - 1),
+    ("SHL", [1, 256], 0),
+])
+def test_binary_ops(op, inputs, expected):
+    state = make_state()
+    push(state, *inputs)
+    result = run(state, op)
+    assert len(result) == 1
+    top = result[0].mstate.stack[-1]
+    assert top.raw.is_const, f"{op} result symbolic: {top}"
+    assert top.value == expected % M
+
+
+def test_stack_ops():
+    state = make_state()
+    push(state, 1, 2, 3)
+    state = run(state, "DUP2")[0]
+    assert state.mstate.stack[-1].value == 2
+    state = run(state, "SWAP3")[0]
+    assert state.mstate.stack[-1].value == 1
+    state = run(state, "POP")[0]
+    assert len(state.mstate.stack) == 3
+
+
+def test_memory_roundtrip():
+    state = make_state()
+    push(state, 0xDEADBEEF, 64)  # value, offset
+    state = run(state, "MSTORE")[0]
+    push(state, 64)
+    state = run(state, "MLOAD")[0]
+    assert state.mstate.stack[-1].value == 0xDEADBEEF
+    assert state.mstate.memory_size >= 96
+
+
+def test_mstore8():
+    state = make_state()
+    push(state, 0x1234, 10)
+    state = run(state, "MSTORE8")[0]
+    assert state.mstate.memory[10].value == 0x34
+
+
+def test_storage_roundtrip():
+    state = make_state()
+    push(state, 99, 5)  # value, key
+    state = run(state, "SSTORE")[0]
+    push(state, 5)
+    state = run(state, "SLOAD")[0]
+    assert state.mstate.stack[-1].value == 99
+
+
+def test_sstore_static_protection():
+    state = make_state(static=True)
+    push(state, 99, 5)
+    with pytest.raises(WriteProtection):
+        run(state, "SSTORE")
+
+
+def test_transient_storage():
+    state = make_state()
+    push(state, 77, 3)
+    state = run(state, "TSTORE")[0]
+    push(state, 3)
+    state = run(state, "TLOAD")[0]
+    assert state.mstate.stack[-1].value == 77
+
+
+def test_jumpi_forks_two_ways():
+    # code: PUSH1 01 PUSH1 06 JUMPI STOP JUMPDEST STOP -> JUMPDEST at byte 6
+    state = make_state("0x6001600657005b00")
+    condition = symbol_factory.BitVecSym("cond", 256)
+    state.mstate.stack.append(condition)              # condition (symbolic)
+    state.mstate.stack.append(symbol_factory.BitVecVal(6, 256))  # dest
+    states = run(state, "JUMPI")
+    assert len(states) == 2
+    fallthrough, taken = states
+    assert fallthrough.mstate.pc == state.mstate.pc + 1
+    jumpdest_index = state.environment.code.index_of_address(6)
+    assert taken.mstate.pc == jumpdest_index
+    assert len(taken.world_state.constraints) == 1
+
+
+def test_jumpi_concrete_condition_single_branch():
+    state = make_state("0x6001600657005b00")
+    push(state, 1, 6)  # condition=1, dest=6
+    states = run(state, "JUMPI")
+    assert len(states) == 1
+    assert states[0].mstate.pc == state.environment.code.index_of_address(6)
+
+
+def test_invalid_jump_rejected():
+    from mythril_tpu.core.util import InvalidJumpDestination
+
+    state = make_state("0x600456005b00")
+    push(state, 3)  # byte 3 is not a JUMPDEST
+    with pytest.raises(InvalidJumpDestination):
+        run(state, "JUMP")
+
+
+def test_sha3_concrete():
+    from mythril_tpu.utils.keccak import keccak256
+
+    state = make_state()
+    push(state, 0xAB, 0)
+    state = run(state, "MSTORE8")[0]
+    push(state, 1, 0)  # size=1, offset=0
+    state = run(state, "SHA3")[0]
+    assert state.mstate.stack[-1].value == int.from_bytes(keccak256(b"\xab"), "big")
+
+
+def test_sha3_symbolic_goes_through_uf():
+    state = make_state()
+    state.mstate.memory[0] = symbol_factory.BitVecSym("mystery", 8)
+    push(state, 1, 0)
+    state = run(state, "SHA3")[0]
+    assert not state.mstate.stack[-1].raw.is_const
+    from mythril_tpu.core.function_managers import keccak_function_manager
+
+    assert keccak_function_manager.create_conditions()  # axioms got registered
+
+
+def test_calldata_ops():
+    state = make_state(calldata=ConcreteCalldata("1", [0xAA, 0xBB]))
+    push(state, 0)
+    state = run(state, "CALLDATALOAD")[0]
+    assert state.mstate.stack[-1].value >> 240 == 0xAABB
+    state = run(state, "CALLDATASIZE")[0]
+    assert state.mstate.stack[-1].value == 2
+
+
+def test_env_ops():
+    state = make_state()
+    for op, expected in [("ADDRESS", 0x1AAF), ("CALLER", 0xCAFE),
+                         ("ORIGIN", 0xCAFE), ("CALLVALUE", 0),
+                         ("BASEFEE", 7), ("CHAINID", 1)]:
+        result = run(state, op)[0]
+        assert result.mstate.stack.pop().value == expected, op
+
+
+def test_selfbalance_and_balance():
+    state = make_state()
+    state = run(state, "SELFBALANCE")[0]
+    assert state.mstate.stack[-1].value == 10 ** 18
+
+
+def test_invalid_opcode():
+    state = make_state()
+    with pytest.raises(InvalidInstruction):
+        run(state, "INVALID")
+
+
+def test_stop_raises_end_signal():
+    from mythril_tpu.core.transaction import TransactionEndSignal
+
+    state = make_state()
+    with pytest.raises(TransactionEndSignal):
+        run(state, "STOP")
+
+
+def test_push_truncated_immediate():
+    state = make_state()
+    code = Disassembly("0x61aa")  # PUSH2 with one byte: pads right
+    state.environment.code = code
+    state.environment.active_account.code = code
+    instruction = Instruction("PUSH2")
+    states = instruction.evaluate(state)
+    assert states[0].mstate.stack[-1].value == 0xAA00
