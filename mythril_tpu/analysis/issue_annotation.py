@@ -1,0 +1,23 @@
+"""IssueAnnotation (API parity: mythril/analysis/issue_annotation.py:9): ties an
+Issue to the conditions under which it fired (used by symbolic summaries)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core.state.annotation import StateAnnotation
+from ..smt import Bool
+
+
+class IssueAnnotation(StateAnnotation):
+    def __init__(self, conditions: List[Bool], issue, detector):
+        self.conditions = conditions
+        self.issue = issue
+        self.detector = detector
+
+    @property
+    def persist_to_world_state(self) -> bool:
+        return True
+
+    def __copy__(self):
+        return IssueAnnotation(list(self.conditions), self.issue, self.detector)

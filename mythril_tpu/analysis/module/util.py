@@ -1,0 +1,36 @@
+"""Hook wiring for CALLBACK detection modules (API parity:
+mythril/analysis/module/util.py — get_detection_module_hooks)."""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from typing import Callable, Dict, List
+
+from .base import DetectionModule, EntryPoint
+from .loader import ModuleLoader
+
+log = logging.getLogger(__name__)
+
+
+def get_detection_module_hooks(modules: List[DetectionModule],
+                               hook_type: str = "pre") -> Dict[str, List[Callable]]:
+    hook_dict: Dict[str, List[Callable]] = defaultdict(list)
+    for module in modules:
+        hooks = module.pre_hooks if hook_type == "pre" else module.post_hooks
+        for op_code in hooks:
+            def hook_wrapper(module_reference=module):
+                def hook(global_state):
+                    module_reference.execute(global_state)
+
+                return hook
+
+            hook_dict[op_code].append(hook_wrapper())
+    return dict(hook_dict)
+
+
+def reset_callback_modules(module_names=(), allow_blank_modules: bool = False) -> None:
+    modules = ModuleLoader().get_detection_modules(
+        entry_point=EntryPoint.CALLBACK, white_list=module_names or None)
+    for module in modules:
+        module.reset_module()

@@ -1,0 +1,54 @@
+"""SWC-127 Arbitrary jump (capability parity:
+mythril/analysis/module/modules/arbitrary_jump.py: JUMP destination is symbolic and
+attacker-influenceable)."""
+
+from __future__ import annotations
+
+import logging
+
+from ...core.state.global_state import GlobalState
+from ...exceptions import UnsatError
+from ..module.base import DetectionModule, EntryPoint
+from ..report import Issue
+from ..solver import get_transaction_sequence
+from ..swc_data import ARBITRARY_JUMP
+
+log = logging.getLogger(__name__)
+
+
+class ArbitraryJump(DetectionModule):
+    name = "Caller can redirect execution to arbitrary bytecode locations"
+    swc_id = ARBITRARY_JUMP
+    description = "Check for jumps to arbitrary locations in the bytecode"
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["JUMP", "JUMPI"]
+
+    def _execute(self, state: GlobalState):
+        jump_dest = state.mstate.stack[-1]
+        if jump_dest.raw.is_const:
+            return []
+        try:
+            transaction_sequence = get_transaction_sequence(
+                state, state.world_state.constraints.get_all_constraints())
+        except UnsatError:
+            return []
+        return [Issue(
+            contract=state.environment.active_account.contract_name,
+            function_name=getattr(state.environment, "active_function_name",
+                                  "fallback"),
+            address=state.get_current_instruction()["address"],
+            swc_id=self.swc_id,
+            title="Jump to an arbitrary instruction",
+            severity="High",
+            bytecode=state.environment.code.bytecode,
+            description_head="The caller can redirect execution to arbitrary "
+                             "bytecode locations.",
+            description_tail=(
+                "It is possible to redirect the control flow to arbitrary "
+                "locations in the code. This may allow an attacker to bypass "
+                "security controls or manipulate the business logic of the "
+                "smart contract. Avoid using low-level-operations and "
+                "assembly to prevent this issue."),
+            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+            transaction_sequence=transaction_sequence,
+        )]

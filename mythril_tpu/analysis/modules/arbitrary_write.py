@@ -1,0 +1,53 @@
+"""SWC-124 Write to arbitrary storage (capability parity:
+mythril/analysis/module/modules/arbitrary_write.py: SSTORE key fully
+attacker-controllable)."""
+
+from __future__ import annotations
+
+import logging
+
+from ...core.state.global_state import GlobalState
+from ...smt import symbol_factory
+from ..module.base import DetectionModule, EntryPoint
+from ..potential_issues import PotentialIssue, get_potential_issues_annotation
+from ..swc_data import WRITE_TO_ARBITRARY_STORAGE
+
+log = logging.getLogger(__name__)
+
+
+class ArbitraryStorage(DetectionModule):
+    name = "Caller can write to arbitrary storage locations"
+    swc_id = WRITE_TO_ARBITRARY_STORAGE
+    description = "Check for writes to arbitrary storage locations"
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["SSTORE"]
+
+    def _execute(self, state: GlobalState):
+        write_slot = state.mstate.stack[-1]
+        if write_slot.raw.is_const:
+            return []
+        # attacker-chosen probe slot: if the symbolic key can equal an arbitrary
+        # fresh value, the write is unconstrained
+        probe = symbol_factory.BitVecSym(f"probe_slot_{id(self)}", 256)
+        potential_issue = PotentialIssue(
+            contract=state.environment.active_account.contract_name,
+            function_name=getattr(state.environment, "active_function_name",
+                                  "fallback"),
+            address=state.get_current_instruction()["address"],
+            swc_id=self.swc_id,
+            title="Write to an arbitrary storage location",
+            severity="High",
+            bytecode=state.environment.code.bytecode,
+            description_head="The caller can write to arbitrary storage "
+                             "locations.",
+            description_tail=(
+                "It is possible to write to arbitrary storage locations. By "
+                "modifying the values of storage variables, attackers may bypass "
+                "security controls or manipulate the business logic of the smart "
+                "contract."),
+            detector=self,
+            constraints=[write_slot == probe],
+        )
+        get_potential_issues_annotation(state).potential_issues.append(
+            potential_issue)
+        return []

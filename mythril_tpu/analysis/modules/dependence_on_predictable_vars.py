@@ -1,0 +1,109 @@
+"""SWC-116/120 block-value dependence (capability parity:
+mythril/analysis/module/modules/dependence_on_predictable_vars.py: TIMESTAMP /
+NUMBER / PREVRANDAO / COINBASE / GASLIMIT values influencing control flow ahead of
+an ether transfer, and BLOCKHASH of a predictable block)."""
+
+from __future__ import annotations
+
+import logging
+
+from ...core.state.global_state import GlobalState
+from ...exceptions import UnsatError
+from ..module.base import DetectionModule, EntryPoint
+from ..report import Issue
+from ..solver import get_transaction_sequence
+from ..swc_data import TIMESTAMP_DEPENDENCE, WEAK_RANDOMNESS
+
+log = logging.getLogger(__name__)
+
+PREDICTABLE_OPS = ["TIMESTAMP", "NUMBER", "COINBASE", "GASLIMIT", "PREVRANDAO",
+                   "DIFFICULTY"]
+
+
+class PredictableValueAnnotation:
+    def __init__(self, operation: str):
+        self.operation = operation
+
+
+class PredictablePathAnnotation:
+    """State annotation: control flow already branched on a predictable value."""
+
+    def __init__(self, operation: str, location: int):
+        self.operation = operation
+        self.location = location
+
+    def __copy__(self):
+        return PredictablePathAnnotation(self.operation, self.location)
+
+
+class PredictableVariables(DetectionModule):
+    name = "Control flow depends on a predictable environment variable"
+    swc_id = f"{TIMESTAMP_DEPENDENCE}, {WEAK_RANDOMNESS}"
+    description = ("Check whether control flow decisions are influenced by block "
+                   "attributes (block.number, block.timestamp, block.prevrandao, "
+                   "coinbase, gaslimit) or blockhash.")
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["JUMPI", "BLOCKHASH", "CALL"]
+    post_hooks = PREDICTABLE_OPS
+
+    def _execute(self, state: GlobalState):
+        instruction = state.get_current_instruction()
+        opcode = instruction["opcode"]
+        if opcode not in ("JUMPI", "CALL", "BLOCKHASH"):
+            # post-hook on a block-value op (fires on the successor state):
+            # the producing instruction is the previous one
+            producer = state.environment.code.instruction_list[
+                state.mstate.pc - 1].op_code
+            operation = "block.timestamp" if producer == "TIMESTAMP" else \
+                f"block.{producer.lower()}"
+            state.mstate.stack[-1].annotate(PredictableValueAnnotation(operation))
+            return []
+
+        if opcode == "BLOCKHASH":
+            # pre-hook: blockhash of a predictable block is weak randomness
+            state.mstate.stack[-1].annotate(
+                PredictableValueAnnotation("blockhash"))
+            return []
+
+        if opcode == "JUMPI":
+            condition = state.mstate.stack[-2]
+            markers = [annotation for annotation in condition.annotations
+                       if isinstance(annotation, PredictableValueAnnotation)]
+            if markers:
+                state.annotate(PredictablePathAnnotation(
+                    markers[0].operation, instruction["address"]))
+            return []
+
+        # CALL with value, on a path that branched on a predictable value
+        annotations = [a for a in state.annotations
+                       if isinstance(a, PredictablePathAnnotation)]
+        if not annotations:
+            return []
+        try:
+            transaction_sequence = get_transaction_sequence(
+                state, state.world_state.constraints.get_all_constraints())
+        except UnsatError:
+            return []
+        operation = annotations[0].operation
+        swc_id = TIMESTAMP_DEPENDENCE if "timestamp" in operation else WEAK_RANDOMNESS
+        return [Issue(
+            contract=state.environment.active_account.contract_name,
+            function_name=getattr(state.environment, "active_function_name",
+                                  "fallback"),
+            address=annotations[0].location,
+            swc_id=swc_id,
+            bytecode=state.environment.code.bytecode,
+            title="Dependence on predictable environment variable",
+            severity="Low",
+            description_head=f"A control flow decision is made based on "
+                             f"{operation}.",
+            description_tail=(
+                f"The {operation} environment variable is used to determine a "
+                "control flow decision ahead of an ether transfer. Note that the "
+                "values of variables like coinbase, gaslimit, block number and "
+                "timestamp are predictable and can be manipulated by a malicious "
+                "miner. Don't use them for random number generation or to make "
+                "critical control flow decisions."),
+            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+            transaction_sequence=transaction_sequence,
+        )]

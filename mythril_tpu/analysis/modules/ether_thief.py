@@ -1,0 +1,64 @@
+"""SWC-105 Unprotected Ether Withdrawal (capability parity:
+mythril/analysis/module/modules/ether_thief.py — two-phase PotentialIssue flow:
+attacker ends with more ether than they put in)."""
+
+from __future__ import annotations
+
+import logging
+
+from ...core.state.global_state import GlobalState
+from ...core.transaction.symbolic import ACTORS
+from ...core.transaction.transaction_models import ContractCreationTransaction
+from ...smt import UGT, symbol_factory
+from ..module.base import DetectionModule, EntryPoint
+from ..potential_issues import PotentialIssue, get_potential_issues_annotation
+from ..swc_data import UNPROTECTED_ETHER_WITHDRAWAL
+
+log = logging.getLogger(__name__)
+
+
+class EtherThief(DetectionModule):
+    name = "Any sender can withdraw ETH from the contract account"
+    swc_id = UNPROTECTED_ETHER_WITHDRAWAL
+    description = ("Search for cases where Ether can be withdrawn to a "
+                   "user-specified address.")
+    entry_point = EntryPoint.CALLBACK
+    post_hooks = ["CALL", "STATICCALL"]
+
+    def _execute(self, state: GlobalState):
+        # runs right after the CALL's post handler: inspect the completed transfer
+        world_state = state.world_state
+        constraints = []
+        for transaction in world_state.transaction_sequence:
+            if not isinstance(transaction, ContractCreationTransaction):
+                constraints.append(transaction.caller == ACTORS.attacker)
+                # the attacker does not fund the contract themselves beyond dust
+                constraints.append(transaction.call_value == 0)
+
+        # attacker's final balance strictly exceeds their starting balance
+        constraints.append(UGT(
+            world_state.balances[ACTORS.attacker],
+            world_state.starting_balances[ACTORS.attacker]))
+
+        potential_issue = PotentialIssue(
+            contract=state.environment.active_account.contract_name,
+            function_name=getattr(state.environment, "active_function_name",
+                                  "fallback"),
+            address=state.get_current_instruction()["address"] - 1,
+            swc_id=self.swc_id,
+            title="Unprotected Ether Withdrawal",
+            severity="High",
+            bytecode=state.environment.code.bytecode,
+            description_head="Any sender can withdraw Ether from the contract "
+                             "account.",
+            description_tail=(
+                "Arbitrary senders other than the contract creator can profitably "
+                "extract Ether from the contract account. Verify the business "
+                "logic carefully and make sure that appropriate security controls "
+                "are in place to prevent unexpected loss of funds."),
+            detector=self,
+            constraints=constraints,
+        )
+        get_potential_issues_annotation(state).potential_issues.append(
+            potential_issue)
+        return []

@@ -1,0 +1,51 @@
+"""SWC-110 Assert violation via reachable INVALID/assert-fail (capability parity:
+mythril/analysis/module/modules/exceptions.py)."""
+
+from __future__ import annotations
+
+import logging
+
+from ...core.state.global_state import GlobalState
+from ...exceptions import UnsatError
+from ..module.base import DetectionModule, EntryPoint
+from ..report import Issue
+from ..solver import get_transaction_sequence
+from ..swc_data import ASSERT_VIOLATION
+
+log = logging.getLogger(__name__)
+
+
+class Exceptions(DetectionModule):
+    name = "Assertion violation"
+    swc_id = ASSERT_VIOLATION
+    description = "Check whether an exception is triggered (reachable INVALID)."
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["INVALID"]
+
+    def _execute(self, state: GlobalState):
+        instruction = state.get_current_instruction()
+        try:
+            transaction_sequence = get_transaction_sequence(
+                state, state.world_state.constraints.get_all_constraints())
+        except UnsatError:
+            return []
+        return [Issue(
+            contract=state.environment.active_account.contract_name,
+            function_name=getattr(state.environment, "active_function_name",
+                                  "fallback"),
+            address=instruction["address"],
+            swc_id=self.swc_id,
+            title="Exception State",
+            severity="Medium",
+            bytecode=state.environment.code.bytecode,
+            description_head="An assertion violation was triggered.",
+            description_tail=(
+                "It is possible to trigger an assertion violation. Note that "
+                "Solidity assert() statements should only be used to check "
+                "invariants. Review the transaction trace generated for this "
+                "issue and either make sure your program logic is correct, or "
+                "use require() instead of assert() if your goal is to constrain "
+                "user inputs or enforce preconditions."),
+            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+            transaction_sequence=transaction_sequence,
+        )]

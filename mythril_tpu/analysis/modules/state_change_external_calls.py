@@ -1,0 +1,98 @@
+"""SWC-107 State change after external call (capability parity:
+mythril/analysis/module/modules/state_change_external_calls.py)."""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from ...core.state.annotation import StateAnnotation
+from ...core.state.global_state import GlobalState
+from ...exceptions import UnsatError
+from ...smt import BitVec, UGT, symbol_factory
+from ...support.model import get_model
+from ..module.base import DetectionModule, EntryPoint
+from ..potential_issues import PotentialIssue, get_potential_issues_annotation
+from ..swc_data import REENTRANCY
+
+log = logging.getLogger(__name__)
+
+
+class StateChangeCallsAnnotation(StateAnnotation):
+    def __init__(self, call_state: GlobalState, user_defined_address: bool):
+        self.call_state = call_state
+        self.state_change_states: List[GlobalState] = []
+        self.user_defined_address = user_defined_address
+
+    def __copy__(self):
+        result = StateChangeCallsAnnotation(self.call_state,
+                                            self.user_defined_address)
+        result.state_change_states = list(self.state_change_states)
+        return result
+
+
+class StateChangeAfterCall(DetectionModule):
+    name = "State change after an external call"
+    swc_id = REENTRANCY
+    description = ("Check whether the account state is accessed after an "
+                   "external call to a user-defined address.")
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["CALL", "SSTORE", "DELEGATECALL", "CALLCODE"]
+
+    STATE_READ_WRITE_LIST = ["SSTORE", "SLOAD", "CREATE", "CREATE2"]
+
+    def _execute(self, state: GlobalState):
+        opcode = state.get_current_instruction()["opcode"]
+        annotations = [a for a in state.annotations
+                       if isinstance(a, StateChangeCallsAnnotation)]
+
+        if opcode in ("CALL", "DELEGATECALL", "CALLCODE"):
+            gas = state.mstate.stack[-1]
+            to = state.mstate.stack[-2]
+            # a call that forwards enough gas for reentry
+            try:
+                get_model(tuple(
+                    state.world_state.constraints.get_all_constraints()
+                    + [UGT(gas, symbol_factory.BitVecVal(2300, 256))]))
+            except UnsatError:
+                return []
+            user_defined = not to.raw.is_const or (
+                to.raw.is_const and to.value > 10
+                and to.value not in state.world_state.accounts)
+            state.annotate(StateChangeCallsAnnotation(state, user_defined))
+            return []
+
+        # SSTORE after a prior qualifying call
+        issues = []
+        for annotation in annotations:
+            call_state = annotation.call_state
+            severity = "Medium" if annotation.user_defined_address else "Low"
+            address_desc = ("user-defined" if annotation.user_defined_address
+                            else "fixed")
+            potential_issue = PotentialIssue(
+                contract=state.environment.active_account.contract_name,
+                function_name=getattr(state.environment,
+                                      "active_function_name", "fallback"),
+                address=call_state.get_current_instruction()["address"],
+                swc_id=self.swc_id,
+                title="State access after external call",
+                severity=severity,
+                bytecode=state.environment.code.bytecode,
+                description_head=f"Write to persistent state following an "
+                                 f"external call to a {address_desc} address.",
+                description_tail=(
+                    "The contract account state is accessed after an external "
+                    "call. To prevent reentrancy issues, consider accessing the "
+                    "state only before the call, especially if the callee is "
+                    "untrusted. Alternatively, a reentrancy lock can be used to "
+                    "prevent untrusted callees from re-entering the contract in "
+                    "an intermediate state."),
+                detector=self,
+                constraints=[],
+            )
+            get_potential_issues_annotation(state).potential_issues.append(
+                potential_issue)
+        # consume annotations so each call reports at most once
+        state._annotations = [a for a in state.annotations
+                              if not isinstance(a, StateChangeCallsAnnotation)]
+        return []

@@ -1,0 +1,68 @@
+"""SWC-114 Transaction order dependence (capability parity:
+mythril/analysis/module/modules/transaction_order_dependence.py: the value or
+target of an ether transfer depends on storage another transaction can change)."""
+
+from __future__ import annotations
+
+import logging
+
+from ...core.state.global_state import GlobalState
+from ...exceptions import UnsatError
+from ...smt import UGT, symbol_factory, terms
+from ..module.base import DetectionModule, EntryPoint
+from ..report import Issue
+from ..solver import get_transaction_sequence
+from ..swc_data import TX_ORDER_DEPENDENCE
+
+log = logging.getLogger(__name__)
+
+
+class TxOrderDependence(DetectionModule):
+    name = "Transaction order dependence"
+    swc_id = TX_ORDER_DEPENDENCE
+    description = ("Check whether the value or target of an ether transfer "
+                   "depends on mutable storage (front-runnable).")
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["CALL"]
+
+    def _execute(self, state: GlobalState):
+        value = state.mstate.stack[-3]
+        to = state.mstate.stack[-2]
+        # the transfer is order-dependent when value or target reads storage
+        if not (_depends_on_storage(value) or _depends_on_storage(to)):
+            return []
+        try:
+            transaction_sequence = get_transaction_sequence(
+                state,
+                state.world_state.constraints.get_all_constraints()
+                + [UGT(value, symbol_factory.BitVecVal(0, 256))])
+        except UnsatError:
+            return []
+        return [Issue(
+            contract=state.environment.active_account.contract_name,
+            function_name=getattr(state.environment, "active_function_name",
+                                  "fallback"),
+            address=state.get_current_instruction()["address"],
+            swc_id=self.swc_id,
+            bytecode=state.environment.code.bytecode,
+            title="Transaction Order Dependence",
+            severity="Medium",
+            description_head="The value of the call is dependent on storage "
+                             "that other transactions can modify.",
+            description_tail=(
+                "The value or target of this ether transfer is read from "
+                "contract storage. Another pending transaction that writes "
+                "this storage can front-run this transfer and change its "
+                "outcome (race condition / SWC-114). Consider using "
+                "pull-payment patterns or commit-reveal schemes."),
+            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+            transaction_sequence=transaction_sequence,
+        )]
+
+
+def _depends_on_storage(expression) -> bool:
+    for node in terms.walk(expression.raw):
+        if node.op == "select" or (node.op == "var" and
+                                   str(node.params[0]).startswith("Storage[")):
+            return True
+    return False

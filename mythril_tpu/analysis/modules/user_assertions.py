@@ -1,0 +1,86 @@
+"""SWC-110 user-level assertion reporting (capability parity:
+mythril/analysis/module/modules/user_assertions.py: decodes Panic(uint256) and
+assert-style revert payloads)."""
+
+from __future__ import annotations
+
+import logging
+
+from ...core.state.global_state import GlobalState
+from ...exceptions import UnsatError
+from ...smt import BitVec
+from ..module.base import DetectionModule, EntryPoint
+from ..report import Issue
+from ..solver import get_transaction_sequence
+from ..swc_data import ASSERT_VIOLATION
+
+log = logging.getLogger(__name__)
+
+PANIC_SELECTOR = 0x4E487B71  # keccak("Panic(uint256)")[:4]
+ERROR_SELECTOR = 0x08C379A0  # keccak("Error(string)")[:4]
+
+PANIC_CODES = {
+    0x01: "generic assert violation",
+    0x11: "arithmetic overflow/underflow (checked arithmetic)",
+    0x12: "division by zero",
+    0x21: "enum conversion out of range",
+    0x31: "pop on empty array",
+    0x32: "array index out of bounds",
+    0x41: "allocation of too much memory",
+    0x51: "call to a zero-initialized internal function",
+}
+
+
+class UserAssertions(DetectionModule):
+    name = "A user-defined assertion has been triggered"
+    swc_id = ASSERT_VIOLATION
+    description = "Search for reachable user-supplied exceptions (Panic/Error reverts)."
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["REVERT"]
+
+    def _execute(self, state: GlobalState):
+        offset, length = state.mstate.stack[-1], state.mstate.stack[-2]
+        if not (offset.raw.is_const and length.raw.is_const):
+            return []
+        size = length.value
+        if size < 4:
+            return []
+        data = state.mstate.memory[offset.value:offset.value + min(size, 68)]
+        if not all(isinstance(b, BitVec) and b.raw.is_const for b in data[:4]):
+            return []
+        selector = int.from_bytes(bytes(b.value for b in data[:4]), "big")
+        if selector == PANIC_SELECTOR and size >= 36:
+            code_bytes = data[4:36]
+            if all(b.raw.is_const for b in code_bytes):
+                panic_code = int.from_bytes(
+                    bytes(b.value for b in code_bytes), "big")
+                if panic_code not in PANIC_CODES:
+                    return []
+                detail = PANIC_CODES[panic_code]
+            else:
+                detail = "panic with symbolic code"
+        elif selector == ERROR_SELECTOR:
+            detail = "require()/revert() with reason string"
+            return []  # plain require failures are not assertion violations
+        else:
+            return []
+        try:
+            transaction_sequence = get_transaction_sequence(
+                state, state.world_state.constraints.get_all_constraints())
+        except UnsatError:
+            return []
+        return [Issue(
+            contract=state.environment.active_account.contract_name,
+            function_name=getattr(state.environment, "active_function_name",
+                                  "fallback"),
+            address=state.get_current_instruction()["address"],
+            swc_id=self.swc_id,
+            title="Exception State",
+            severity="Medium",
+            bytecode=state.environment.code.bytecode,
+            description_head="A user-provided assertion failed.",
+            description_tail=f"A reachable user-level assertion failure was "
+                             f"found: {detail}.",
+            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+            transaction_sequence=transaction_sequence,
+        )]

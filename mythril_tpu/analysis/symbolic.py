@@ -1,0 +1,167 @@
+"""SymExecWrapper: configure and run one full analysis (API parity:
+mythril/analysis/symbolic.py:44 — strategy selection, plugin wiring, detector hook
+installation, sym_exec run, post-hoc Call extraction from the statespace)."""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Dict, List, Optional, Union
+
+from ..core.plugin import LaserPluginLoader
+from ..core.plugin.plugins import (BenchmarkPluginBuilder, CallDepthLimitBuilder,
+                                   CoverageMetricsPluginBuilder,
+                                   CoveragePluginBuilder, DependencyPrunerBuilder,
+                                   InstructionProfilerBuilder,
+                                   MutationPrunerBuilder)
+from ..core.strategy import (BasicSearchStrategy, BeamSearch,
+                             BoundedLoopsStrategy, BreadthFirstSearchStrategy,
+                             DelayConstraintStrategy, DepthFirstSearchStrategy,
+                             ReturnRandomNaivelyStrategy,
+                             ReturnWeightedRandomStrategy)
+from ..core.svm import LaserEVM
+from ..core.state.world_state import WorldState
+from ..core.transaction.transaction_models import tx_id_manager
+from ..smt import BitVec, symbol_factory
+from ..support.support_args import args
+from .module import ModuleLoader, get_detection_module_hooks
+from .module.base import EntryPoint
+from .ops import Call, VarType, get_variable
+from .potential_issues import check_potential_issues
+
+log = logging.getLogger(__name__)
+
+
+class SymExecWrapper:
+    def __init__(self, contract, address: Optional[Union[int, str, BitVec]],
+                 strategy: str = "dfs", dynloader=None, max_depth: int = 22,
+                 execution_timeout: Optional[int] = None,
+                 loop_bound: int = 3, create_timeout: Optional[int] = None,
+                 transaction_count: int = 2, modules: Optional[List[str]] = None,
+                 compulsory_statespace: bool = True,
+                 disable_dependency_pruning: bool = False,
+                 run_analysis_modules: bool = True, enable_coverage_strategy: bool = False,
+                 custom_modules_directory: str = ""):
+        if isinstance(address, str):
+            address = symbol_factory.BitVecVal(int(address, 16), 256)
+        elif isinstance(address, int):
+            address = symbol_factory.BitVecVal(address, 256)
+
+        strategy_class = {
+            "dfs": DepthFirstSearchStrategy,
+            "bfs": BreadthFirstSearchStrategy,
+            "naive-random": ReturnRandomNaivelyStrategy,
+            "weighted-random": ReturnWeightedRandomStrategy,
+            "beam-search": BeamSearch,
+            "pending": DelayConstraintStrategy,
+        }.get(strategy)
+        if strategy_class is None:
+            raise ValueError(f"invalid search strategy: {strategy}")
+
+        requires_statespace = compulsory_statespace or \
+            len(ModuleLoader().get_detection_modules(
+                EntryPoint.POST, modules)) > 0
+        self.modules = modules
+        tx_id_manager.restart_counter()
+
+        self.laser = LaserEVM(
+            dynamic_loader=dynloader,
+            max_depth=max_depth,
+            execution_timeout=execution_timeout,
+            create_timeout=create_timeout,
+            strategy=strategy_class,
+            transaction_count=transaction_count,
+            requires_statespace=requires_statespace,
+        )
+        if loop_bound is not None:
+            self.laser.extend_strategy(BoundedLoopsStrategy,
+                                       loop_bound=loop_bound)
+
+        plugin_loader = LaserPluginLoader()
+        plugin_loader.reset()
+        plugin_loader.load(CoverageMetricsPluginBuilder())
+        plugin_loader.load(CoveragePluginBuilder())
+        if not args.disable_mutation_pruner:
+            plugin_loader.load(MutationPrunerBuilder())
+        if not args.disable_iprof:
+            plugin_loader.load(InstructionProfilerBuilder())
+        plugin_loader.load(CallDepthLimitBuilder())
+        plugin_loader.add_args("call-depth-limit",
+                               call_depth_limit=args.call_depth_limit)
+        if not disable_dependency_pruning:
+            plugin_loader.load(DependencyPrunerBuilder())
+        plugin_loader.instrument_virtual_machine(self.laser, None)
+
+        self.plugin_loader = plugin_loader
+
+        if run_analysis_modules:
+            analysis_modules = ModuleLoader().get_detection_modules(
+                EntryPoint.CALLBACK, white_list=modules)
+            self.laser.register_hooks(
+                hook_type="pre",
+                hook_dict=get_detection_module_hooks(analysis_modules,
+                                                     hook_type="pre"))
+            self.laser.register_hooks(
+                hook_type="post",
+                hook_dict=get_detection_module_hooks(analysis_modules,
+                                                     hook_type="post"))
+
+            # two-phase PotentialIssue resolution at every transaction end
+            @self.laser.laser_hook("transaction_end")
+            def transaction_end_hook(global_state, transaction,
+                                     return_global_state, revert):
+                if return_global_state is None and not revert:
+                    check_potential_issues(global_state)
+
+        self.address = address
+        if isinstance(contract, str):
+            # raw creation bytecode
+            self.laser.sym_exec(creation_code=contract, contract_name="Unknown")
+        elif hasattr(contract, "creation_code") and contract.creation_code and \
+                getattr(contract, "name", None):
+            self.laser.sym_exec(creation_code=contract.creation_code,
+                                contract_name=contract.name)
+        else:
+            # runtime-code analysis on a fresh world state
+            world_state = WorldState()
+            account = world_state.create_account(
+                balance=10 ** 18,
+                address=address.value if address is not None else None,
+                concrete_storage=False, dynamic_loader=dynloader)
+            from ..frontends.disassembler import Disassembly
+
+            account.code = Disassembly(contract.code if hasattr(contract, "code")
+                                       else contract)
+            account.contract_name = getattr(contract, "name", "Unknown")
+            self.laser.sym_exec(world_state=world_state,
+                                target_address=account.address.value)
+
+        # statespace bookkeeping for POST modules / graph export
+        self.nodes = self.laser.nodes
+        self.edges = self.laser.edges
+        if requires_statespace:
+            self.calls = self._extract_calls()
+        else:
+            self.calls = []
+
+    def _extract_calls(self) -> List[Call]:
+        """Post-hoc Call extraction (reference symbolic.py:250-330)."""
+        calls: List[Call] = []
+        for node_id, node in self.nodes.items():
+            for state in node.states:
+                instruction = state.get_current_instruction()
+                op = instruction["opcode"]
+                if op not in ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL"):
+                    continue
+                stack = state.mstate.stack
+                if len(stack) < 7:
+                    continue
+                if op in ("CALL", "CALLCODE"):
+                    gas, to, value = (get_variable(stack[-1]),
+                                      get_variable(stack[-2]),
+                                      get_variable(stack[-3]))
+                    calls.append(Call(node, state, None, op, to, gas, value))
+                else:
+                    gas, to = get_variable(stack[-1]), get_variable(stack[-2])
+                    calls.append(Call(node, state, None, op, to, gas))
+        return calls

@@ -1,0 +1,9 @@
+"""Report extra-data carrier filled by plugins (API parity:
+mythril/laser/execution_info.py:4)."""
+
+from __future__ import annotations
+
+
+class ExecutionInfo:
+    def as_dict(self) -> dict:
+        raise NotImplementedError

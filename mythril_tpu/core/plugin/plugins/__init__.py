@@ -1,0 +1,14 @@
+from .mutation_pruner import MutationPrunerBuilder
+from .dependency_pruner import DependencyPrunerBuilder
+from .call_depth_limiter import CallDepthLimitBuilder
+from .coverage import CoveragePluginBuilder
+from .coverage_metrics import CoverageMetricsPluginBuilder
+from .instruction_profiler import InstructionProfilerBuilder
+from .benchmark import BenchmarkPluginBuilder
+from .trace import TraceFinderBuilder
+
+__all__ = [
+    "MutationPrunerBuilder", "DependencyPrunerBuilder", "CallDepthLimitBuilder",
+    "CoveragePluginBuilder", "CoverageMetricsPluginBuilder",
+    "InstructionProfilerBuilder", "BenchmarkPluginBuilder", "TraceFinderBuilder",
+]

@@ -1,0 +1,56 @@
+"""Benchmark plugin: states/sec + coverage over time (capability parity:
+mythril/laser/plugin/plugins/benchmark.py:19 — without the matplotlib dependency;
+emits a dict consumable by bench.py)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+from ...state.global_state import GlobalState
+from ..builder import PluginBuilder
+from ..interface import LaserPlugin
+
+
+class BenchmarkPlugin(LaserPlugin):
+    def __init__(self, name: str = "benchmark"):
+        self.nr_of_executed_insns = 0
+        self.begin: float = 0.0
+        self.end: float = 0.0
+        self.points: Dict[float, int] = {}
+
+    def initialize(self, symbolic_vm) -> None:
+        self.nr_of_executed_insns = 0
+
+        @symbolic_vm.laser_hook("start_sym_exec")
+        def start_hook():
+            self.begin = time.time()
+
+        @symbolic_vm.laser_hook("stop_sym_exec")
+        def stop_hook():
+            self.end = time.time()
+
+        @symbolic_vm.laser_hook("execute_state")
+        def execute_state_hook(_: GlobalState):
+            self.nr_of_executed_insns += 1
+            self.points[round(time.time() - self.begin, 1)] = \
+                self.nr_of_executed_insns
+
+    @property
+    def states_per_second(self) -> float:
+        duration = (self.end or time.time()) - self.begin
+        return self.nr_of_executed_insns / duration if duration > 0 else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "executed_instructions": self.nr_of_executed_insns,
+            "duration": (self.end or time.time()) - self.begin,
+            "states_per_second": self.states_per_second,
+        }
+
+
+class BenchmarkPluginBuilder(PluginBuilder):
+    name = "benchmark"
+
+    def __call__(self, *args, **kwargs) -> LaserPlugin:
+        return BenchmarkPlugin()

@@ -1,0 +1,68 @@
+"""Per-opcode wall-time profiler (capability parity:
+mythril/laser/plugin/plugins/instruction_profiler.py:41).
+
+The engine is single-threaded and sequential, so one pending (opcode, start-time)
+slot suffices: each execute_state settles the previous instruction's timing and
+opens its own."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional, Tuple
+
+from ...state.global_state import GlobalState
+from ..builder import PluginBuilder
+from ..interface import LaserPlugin
+
+log = logging.getLogger(__name__)
+
+
+class InstructionProfiler(LaserPlugin):
+    def __init__(self):
+        #: opcode -> (min, max, total_seconds, count)
+        self.records: Dict[str, Tuple[float, float, float, int]] = {}
+        self._pending: Optional[Tuple[str, float]] = None
+
+    def initialize(self, symbolic_vm) -> None:
+        @symbolic_vm.laser_hook("execute_state")
+        def tick(global_state: GlobalState):
+            now = time.monotonic()
+            self._settle(now)
+            op = global_state.get_current_instruction()["opcode"]
+            self._pending = (op, now)
+
+        @symbolic_vm.laser_hook("stop_sym_exec")
+        def print_results():
+            self._settle(time.monotonic())
+            if self.records:
+                log.info("\n%s", self.report())
+
+    def _settle(self, now: float) -> None:
+        if self._pending is None:
+            return
+        op, started = self._pending
+        self._pending = None
+        elapsed = now - started
+        minimum, maximum, total, count = self.records.get(
+            op, (float("inf"), 0.0, 0.0, 0))
+        self.records[op] = (min(minimum, elapsed), max(maximum, elapsed),
+                            total + elapsed, count + 1)
+
+    def report(self) -> str:
+        lines = ["Instruction Perf Profile:"]
+        total_time = sum(rec[2] for rec in self.records.values())
+        for op, (minimum, maximum, total, count) in sorted(
+                self.records.items(), key=lambda kv: -kv[1][2]):
+            lines.append(
+                f"  [{total / max(total_time, 1e-12) * 100:6.2f} %] {op}: "
+                f"{count} calls, avg {total / count * 1e6:.1f}us, "
+                f"min {minimum * 1e6:.1f}us, max {maximum * 1e6:.1f}us")
+        return "\n".join(lines)
+
+
+class InstructionProfilerBuilder(PluginBuilder):
+    name = "instruction-profiler"
+
+    def __call__(self, *args, **kwargs) -> LaserPlugin:
+        return InstructionProfiler()

@@ -226,6 +226,9 @@ class LaserEVM:
                 post_hooks=self.instr_post_hook[op_code],
             ).evaluate(global_state)
 
+        except PluginSkipState:
+            new_global_states = []
+
         except VmException as error:
             error_state = copy(global_state)
             self._handle_vm_exception(error_state, op_code, error)
