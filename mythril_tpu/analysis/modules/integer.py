@@ -14,6 +14,7 @@ from __future__ import annotations
 import logging
 from typing import List, Set
 
+from ...core.state.annotation import StateAnnotation
 from ...core.state.global_state import GlobalState
 from ...exceptions import UnsatError
 from ...smt import (BVAddNoOverflow, BVMulNoOverflow, BVSubNoUnderflow,
@@ -41,7 +42,7 @@ class OverUnderflowAnnotation:
         return self
 
 
-class OverUnderflowStateAnnotation:
+class OverUnderflowStateAnnotation(StateAnnotation):
     """State-level set of markers whose values reached a sink on this path."""
 
     def __init__(self):

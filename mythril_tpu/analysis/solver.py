@@ -13,6 +13,7 @@ import logging
 from typing import Dict, List, Optional
 
 from ..core.function_managers import keccak_function_manager
+from ..utils.keccak import keccak256
 from ..core.state.world_state import WorldState
 from ..core.transaction.transaction_models import (BaseTransaction,
                                                    ContractCreationTransaction)
@@ -64,12 +65,7 @@ def get_transaction_sequence(global_state, constraints) -> Dict:
     for transaction in transaction_sequence:
         concrete_transactions.append(
             _get_concrete_transaction(model, transaction))
-
-    min_price_dict: Dict[str, int] = {}
-    for address in initial_accounts.keys():
-        min_price_dict[address] = model.eval(
-            global_state.world_state.starting_balances[
-                symbol_factory.BitVecVal(int(address, 16), 256)])
+    _replace_with_actual_sha(concrete_transactions, model)
 
     steps = {"initialState": {"accounts": initial_accounts},
              "steps": concrete_transactions}
@@ -77,19 +73,35 @@ def get_transaction_sequence(global_state, constraints) -> Dict:
 
 
 def _replace_with_actual_sha(concrete_transactions: List[Dict], model) -> None:
-    """Patch placeholder hash values in witness calldata with real keccaks
-    (reference analysis/solver.py:131)."""
-    concrete_hashes = keccak_function_manager.get_concrete_hash_data(model)
+    """Patch solver-chosen hash values in witness calldata with real keccaks
+    (reference analysis/solver.py:131).
+
+    The owned solver picks a value for each symbolic keccak application that
+    satisfies the interval axioms but is not the real digest; wherever that
+    placeholder word appears in the witness calldata, substitute
+    keccak256(model(input)) so replaying the witness on a real EVM matches."""
+    substitutions: Dict[str, str] = {}
+    for hash_expr, input_expr in keccak_function_manager.quick_inverse.items():
+        try:
+            placeholder_value = model.eval(hash_expr)
+            input_value = model.eval(input_expr)
+        except Exception:
+            continue
+        width = input_expr.size()
+        real = int.from_bytes(
+            keccak256(input_value.to_bytes(width // 8, "big")), "big")
+        if real == placeholder_value:
+            continue
+        substitutions["{:064x}".format(placeholder_value)] = \
+            "{:064x}".format(real)
+    if not substitutions:
+        return
     for transaction in concrete_transactions:
         input_hex = transaction["input"][2:]
-        for length, mapping in concrete_hashes.items():
-            for input_value, hash_value in mapping.items():
-                placeholder = hex(hash_value)[2:].rjust(64, "0")
-                if placeholder in input_hex:
-                    continue  # already the real hash
-    # The owned solver computes real keccaks through the UF congruence axioms,
-    # so placeholders only arise for unconstrained hash applications; those are
-    # left as solver-chosen values (still satisfying all interval axioms).
+        for placeholder, real_hex in substitutions.items():
+            input_hex = input_hex.replace(placeholder, real_hex)
+        transaction["input"] = "0x" + input_hex
+        transaction["calldata"] = transaction["input"]
 
 
 def _get_concrete_transaction(model, transaction: BaseTransaction) -> Dict:

@@ -98,6 +98,8 @@ class Instruction:
         gas_min, gas_max = meta[GAS]
         global_state.mstate.min_gas_used += gas_min
         global_state.mstate.max_gas_used += gas_max
+        # certainly-OOG paths abort here (reference instructions.py:163-187)
+        global_state.mstate.check_gas()
 
     def evaluate(self, global_state: GlobalState, post: bool = False) -> List[GlobalState]:
         op = self.op_code.lower()

@@ -1,0 +1,22 @@
+"""TPU execution lane: batched lockstep EVM interpretation + batched solving.
+
+This package is the reason the framework exists (SURVEY.md §2.3, §7 stages 7-9):
+the per-state worklist of the host engine (`core/svm.py`) becomes a dense,
+padded StateBatch pytree stepped in lockstep by one jitted function, sharded
+over a `jax.sharding.Mesh` for multi-chip scale.
+
+Modules:
+  words     — 256-bit EVM words as 16x16-bit limbs in uint32 (native TPU lanes)
+  keccak    — batched keccak-256 sponge entirely on device
+  batch     — the StateBatch structure-of-arrays pytree + host converters
+  concrete  — the lockstep concrete interpreter (conformance + concolic replay)
+  jax_solver— batched CNF unit-propagation/DPLL over dense clause matrices
+  frontier  — symbolic frontier stepping (mask-fork JUMPI, lane compaction)
+
+Everything here is JAX; `jax_enable_x64` is switched on at import because gas
+counters exceed 2^32 (word arithmetic itself never needs 64-bit lanes).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -226,7 +226,10 @@ class Blaster:
         r = self.mux_word(sign_a, self.neg(r), r)
         width = len(a)
         b_zero = -self.or_many(b)
-        q = self.mux_word(b_zero, self.const_bits((1 << width) - 1, width), q)
+        # SMT-LIB: bvsdiv x 0 = 1 for x < 0, all-ones otherwise; bvsrem x 0 = x
+        div_by_zero = self.mux_word(sign_a, self.const_bits(1, width),
+                                    self.const_bits((1 << width) - 1, width))
+        q = self.mux_word(b_zero, div_by_zero, q)
         r = self.mux_word(b_zero, a, r)
         return q, r
 

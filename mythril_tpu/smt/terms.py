@@ -201,7 +201,9 @@ def _fold_bv_binop(op: str, a: int, b: int, width: int) -> int:
         return (a % b) & mask if b else a
     if op == "bvsdiv":
         if b == 0:
-            return mask
+            # SMT-LIB: bvsdiv x 0 = bvneg(bvudiv (bvneg x) 0) = 1 for x < 0,
+            # all-ones for x >= 0
+            return 1 if _signed(a, width) < 0 else mask
         sa, sb = _signed(a, width), _signed(b, width)
         quotient = abs(sa) // abs(sb)
         if (sa < 0) != (sb < 0):
