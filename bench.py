@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Benchmark: TPU lockstep engine vs the CPU oracle engine.
+
+Measures lane-steps/second (EVM instructions executed across all lanes) on an
+arithmetic/memory/control loop workload, for:
+  - the batched lockstep interpreter (mythril_tpu/parallel/lockstep.py) on the
+    default JAX backend (TPU when present), and
+  - the host oracle interpreter (mythril_tpu/core/) on CPU — the stand-in for
+    the reference's single-threaded Python/Z3 engine (BASELINE.md: the
+    reference publishes no numbers; the CPU engine here implements the same
+    worklist architecture, so the ratio is the honest speedup measure).
+
+Prints exactly one JSON line:
+  {"metric": "lockstep_lane_steps_per_sec", "value": N, "unit": "steps/s",
+   "vs_baseline": M, ...extras}
+"""
+
+import json
+import sys
+import time
+
+# loop: counter += 1; mem[0] = counter; while LIMIT > counter  (8 instrs/iter)
+LOOP_CODE = bytes.fromhex(
+    "6000"          # PUSH1 0        counter
+    "5b"            # JUMPDEST       (pc 2)
+    "6001" "01"     # PUSH1 1; ADD
+    "80" "6000" "52"  # DUP1; PUSH1 0; MSTORE
+    "80" "63002dc6c0" "11"  # DUP1; PUSH4 3000000; GT
+    "6002" "57"     # PUSH1 2; JUMPI
+    "00"            # STOP
+)
+INSTRS_PER_ITER = 8
+
+
+def bench_lockstep(n_lanes: int = 512, seconds: float = 10.0):
+    import jax
+    from mythril_tpu.parallel import batch as pbatch
+    from mythril_tpu.parallel import lockstep
+
+    specs = [pbatch.LaneSpec(LOOP_CODE, gas_limit=2 ** 60)
+             for _ in range(n_lanes)]
+    state = pbatch.build_batch(specs, stack_slots=16, memory_bytes=64,
+                               calldata_bytes=32, retdata_bytes=32,
+                               storage_slots=4, tstore_slots=2)
+    chunk = 128
+    # warm-up / compile
+    state = lockstep.step_many(state, chunk)
+    jax.block_until_ready(state.pc)
+
+    steps = 0
+    start = time.perf_counter()
+    while time.perf_counter() - start < seconds:
+        state = lockstep.step_many(state, chunk)
+        jax.block_until_ready(state.pc)
+        steps += chunk
+    elapsed = time.perf_counter() - start
+    lane_steps = steps * n_lanes
+    backend = jax.devices()[0].platform
+    return lane_steps / elapsed, backend
+
+
+def bench_oracle(seconds: float = 10.0):
+    from mythril_tpu.core.state.world_state import WorldState
+    from mythril_tpu.core.svm import LaserEVM
+    from mythril_tpu.core.transaction.concolic import execute_message_call
+    from mythril_tpu.frontends.disassembler import Disassembly
+
+    world_state = WorldState()
+    world_state.create_account(balance=0, address=0x1000,
+                               concrete_storage=True)
+    world_state.create_account(balance=2 ** 128, address=0xAAAA)
+
+    laser = LaserEVM(max_depth=10 ** 9, execution_timeout=int(seconds),
+                     requires_statespace=False)
+    laser.open_states = [world_state]
+    start = time.perf_counter()
+    execute_message_call(
+        laser, callee_address=0x1000, caller_address=0xAAAA,
+        origin_address=0xAAAA, code=Disassembly(LOOP_CODE.hex()), data=[],
+        gas_limit=2 ** 60, gas_price=0, value=0)
+    elapsed = time.perf_counter() - start
+    return laser.executed_nodes / max(elapsed, 1e-9)
+
+
+def main():
+    seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
+    tpu_rate, backend = bench_lockstep(seconds=seconds)
+    cpu_rate = bench_oracle(seconds=min(seconds, 10.0))
+    print(json.dumps({
+        "metric": "lockstep_lane_steps_per_sec",
+        "value": round(tpu_rate, 1),
+        "unit": "steps/s",
+        "vs_baseline": round(tpu_rate / max(cpu_rate, 1e-9), 2),
+        "baseline_oracle_steps_per_sec": round(cpu_rate, 1),
+        "backend": backend,
+        "n_lanes": 512,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""Concolic mode: concrete trace recording + branch flipping
+(capability parity: mythril/concolic/ — concolic_execution.py:67,
+find_trace.py:45, concrete_data.py)."""
+
+from .concolic_execution import concolic_execution
+from .find_trace import concrete_execution
+
+__all__ = ["concolic_execution", "concrete_execution"]

@@ -38,7 +38,52 @@ class ConcolicStrategy(BasicSearchStrategy):
         self.results: Dict[str, Dict] = {}
 
     def get_strategic_global_state(self) -> GlobalState:
-        return self.work_list.pop()
+        """Follow the recorded trace; solve deviating states at flip targets
+        (reference strategy/concolic.py:66-115)."""
+        while self.work_list:
+            state = self.work_list.pop()
+            annotations = list(state.get_annotations(TraceAnnotation))
+            if annotations:
+                annotation = annotations[0]
+            else:
+                annotation = TraceAnnotation()
+                state.annotate(annotation)
+
+            index = annotation.trace_index
+            try:
+                address = state.get_current_instruction()["address"]
+            except IndexError:
+                continue
+            if index < len(self.trace) and self.trace[index][0] == address:
+                annotation.trace_index += 1
+                return state
+
+            # deviation from the trace: this state took the OTHER side of the
+            # last JUMPI; if that branch is a flip target, its constraints
+            # describe exactly the inputs that flip it
+            jumpi_address = self._previous_address(state)
+            key = hex(jumpi_address) if jumpi_address is not None else None
+            if key is not None and \
+                    (key in self.flip_branch_addresses
+                     or str(jumpi_address) in self.flip_branch_addresses) \
+                    and key not in self.results:
+                from ...analysis.solver import get_transaction_sequence
+
+                try:
+                    self.results[key] = get_transaction_sequence(
+                        state,
+                        state.world_state.constraints.get_all_constraints())
+                except UnsatError:
+                    log.debug("branch at %s cannot be flipped", key)
+        raise StopIteration
+
+    @staticmethod
+    def _previous_address(state: GlobalState):
+        prev_pc = state.mstate.prev_pc
+        instruction_list = state.environment.code.instruction_list
+        if prev_pc is None or not (0 <= prev_pc < len(instruction_list)):
+            return None
+        return instruction_list[prev_pc].address
 
     def run_check(self) -> bool:
         return len(self.results) != len(self.flip_branch_addresses)

@@ -69,3 +69,35 @@ def _setup_global_state_for_execution(laser_evm, transaction) -> None:
     if getattr(laser_evm, "requires_statespace", False):
         laser_evm.new_node_for_transaction(global_state, transaction)
     laser_evm.work_list.append(global_state)
+
+def execute_contract_creation(laser_evm, callee_address, caller_address,
+                              value, data: List[int], gas_limit: int,
+                              gas_price: int, code: str = "",
+                              origin_address=None,
+                              contract_name: str = "Unknown") -> None:
+    """Execute one concrete creation tx from every open state
+    (reference transaction/concolic.py:74 execute_transaction creation arm)."""
+    from ...frontends.disassembler import Disassembly
+    from .transaction_models import ContractCreationTransaction
+
+    open_states = laser_evm.open_states[:]
+    del laser_evm.open_states[:]
+    if origin_address is None:
+        origin_address = caller_address
+    for open_world_state in open_states:
+        next_transaction_id = get_next_transaction_id()
+        transaction = ContractCreationTransaction(
+            world_state=open_world_state,
+            identifier=next_transaction_id,
+            gas_price=symbol_factory.BitVecVal(gas_price, 256),
+            gas_limit=gas_limit,
+            origin=symbol_factory.BitVecVal(origin_address, 256),
+            code=Disassembly(code),
+            caller=symbol_factory.BitVecVal(caller_address, 256),
+            contract_name=contract_name,
+            call_data=ConcreteCalldata(next_transaction_id, data),
+            call_value=symbol_factory.BitVecVal(value, 256),
+        )
+        _setup_global_state_for_execution(laser_evm, transaction)
+        laser_evm.time = datetime.now()
+        laser_evm.exec(True)

@@ -1,0 +1,5 @@
+"""On-chain access: JSON-RPC client + dynamic loader."""
+
+from .rpc import EthJsonRpc, RPCError
+
+__all__ = ["EthJsonRpc", "RPCError"]

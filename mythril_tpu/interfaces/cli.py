@@ -1,45 +1,286 @@
 """`myth-tpu` command-line interface.
 
-Capability parity target: mythril/interfaces/cli.py (subcommands analyze|a,
-disassemble|d, concolic, safe-functions, read-storage, function-to-hash,
-hash-to-address, list-detectors, version — reference cli.py:243-356). Milestone-1
-stub: disassemble and version are live; analyze lands with the engine.
-"""
+Capability parity: mythril/interfaces/cli.py:243-356 — subcommands
+analyze|a, disassemble|d, foundry|f, concolic, safe-functions, read-storage,
+function-to-hash, hash-to-address, list-detectors, version; the full analysis
+flag surface (strategy, tx count, timeouts, pruning, modules, reports) at
+cli.py:438-600. Exit code 1 iff issues were found (cli.py:880-883).
+
+TPU-specific additions: `--solver jax` selects the batched device solver
+(parallel/jax_solver.py); `--engine lockstep` routes concrete replay through
+the batched interpreter."""
 
 from __future__ import annotations
 
 import argparse
+import json
+import logging
+import os
 import sys
+
+
+def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
+    inputs = parser.add_argument_group("input")
+    inputs.add_argument("solidity_files", nargs="*",
+                        help=".sol files (optionally file:ContractName)")
+    inputs.add_argument("-c", "--code", help="hex creation bytecode")
+    inputs.add_argument("-f", "--codefile",
+                        help="file containing hex bytecode")
+    inputs.add_argument("-a", "--address", help="on-chain contract address")
+    inputs.add_argument("--bin-runtime", action="store_true",
+                        help="treat -c/-f input as runtime (deployed) code")
+
+    options = parser.add_argument_group("options")
+    options.add_argument("-m", "--modules",
+                         help="comma-separated detection module list")
+    options.add_argument("--strategy", default="bfs",
+                         choices=["dfs", "bfs", "naive-random",
+                                  "weighted-random", "beam-search", "pending"])
+    options.add_argument("-t", "--transaction-count", type=int, default=2)
+    options.add_argument("--execution-timeout", type=int, default=86400)
+    options.add_argument("--create-timeout", type=int, default=10)
+    options.add_argument("--solver-timeout", type=int, default=10000)
+    options.add_argument("--max-depth", type=int, default=128)
+    options.add_argument("-b", "--loop-bound", type=int, default=3)
+    options.add_argument("--call-depth-limit", type=int, default=3)
+    options.add_argument("--pruning-factor", type=float, default=None)
+    options.add_argument("--unconstrained-storage", action="store_true")
+    options.add_argument("--disable-dependency-pruning", action="store_true")
+    options.add_argument("--disable-mutation-pruner", action="store_true")
+    options.add_argument("--enable-iprof", action="store_true")
+    options.add_argument("--solver-log", help="directory for .smt2 query dumps")
+    options.add_argument("--solver", default="cdcl", choices=["cdcl", "jax"],
+                         help="SAT backend: native CDCL or batched TPU solver")
+    options.add_argument("--beam-width", type=int, default=None)
+    options.add_argument("--transaction-sequences", default=None,
+                         help="explicit function-sequence list (json)")
+
+    output = parser.add_argument_group("output")
+    output.add_argument("-o", "--outform", default="text",
+                        choices=["text", "json", "jsonv2", "markdown"])
+    output.add_argument("-g", "--graph", help="write call graph HTML here")
+    output.add_argument("-j", "--statespace-json",
+                        help="write statespace JSON here")
+
+    rpc = parser.add_argument_group("rpc")
+    rpc.add_argument("--rpc", help="custom RPC (host:port, ganache, "
+                                   "infura-<net>)")
+    rpc.add_argument("--rpctls", action="store_true")
+
+
+def _load_contracts(parser, cli_args, disassembler):
+    """Resolve the input sources into loaded contracts + target address."""
+    address = cli_args.address
+    if cli_args.code:
+        address, _ = disassembler.load_from_bytecode(
+            cli_args.code, cli_args.bin_runtime, address)
+    elif cli_args.codefile:
+        with open(cli_args.codefile) as handle:
+            code = handle.read().strip()
+        address, _ = disassembler.load_from_bytecode(
+            code, cli_args.bin_runtime, address)
+    elif cli_args.address:
+        address, _ = disassembler.load_from_address(cli_args.address)
+    elif cli_args.solidity_files:
+        address, _ = disassembler.load_from_solidity(cli_args.solidity_files)
+    else:
+        parser.error("no input: provide solidity files, -c, -f or -a")
+    return address
+
+
+def _build_disassembler(cli_args):
+    from ..mythril import MythrilConfig, MythrilDisassembler
+
+    eth = None
+    if getattr(cli_args, "rpc", None) or getattr(cli_args, "address", None):
+        config = MythrilConfig()
+        config.set_api_rpc(getattr(cli_args, "rpc", None),
+                           getattr(cli_args, "rpctls", False))
+        eth = config.eth
+    return MythrilDisassembler(
+        eth=eth,
+        solc_version=getattr(cli_args, "solv", None),
+        solc_settings_json=getattr(cli_args, "solc_json", None))
+
+
+def _format_report(report, outform: str) -> str:
+    return {"text": report.as_text, "json": report.as_json,
+            "jsonv2": report.as_swc_standard_format,
+            "markdown": report.as_markdown}[outform]()
+
+
+def _cmd_analyze(parser, cli_args, safe_functions: bool = False) -> int:
+    from ..mythril import MythrilAnalyzer
+
+    disassembler = _build_disassembler(cli_args)
+    address = _load_contracts(parser, cli_args, disassembler)
+    cli_args.disable_iprof = not cli_args.enable_iprof
+    analyzer = MythrilAnalyzer(disassembler, cmd_args=cli_args,
+                               strategy=cli_args.strategy, address=address)
+
+    if cli_args.graph:
+        with open(cli_args.graph, "w") as handle:
+            handle.write(analyzer.graph_html(
+                transaction_count=cli_args.transaction_count))
+        return 0
+    if cli_args.statespace_json:
+        with open(cli_args.statespace_json, "w") as handle:
+            handle.write(analyzer.dump_statespace(
+                transaction_count=cli_args.transaction_count))
+        return 0
+
+    modules = cli_args.modules.split(",") if cli_args.modules else None
+    report = analyzer.fire_lasers(modules=modules,
+                                  transaction_count=cli_args.transaction_count)
+    if safe_functions:
+        issues = list(report.issues.values())
+        unsafe = {issue.function for issue in issues}
+        all_functions = set()
+        for contract in disassembler.contracts:
+            all_functions.update(contract.disassembly
+                                 .function_name_to_address.keys())
+        safe = sorted(all_functions - unsafe)
+        print(json.dumps({"safe_functions": safe,
+                          "unsafe_functions": sorted(unsafe)}, indent=2))
+        return 0
+    print(_format_report(report, cli_args.outform))
+    return 1 if report.issues else 0
 
 
 def main(argv=None) -> int:
     from .. import __version__
 
-    parser = argparse.ArgumentParser(prog="myth-tpu",
-                                     description="TPU-native EVM security analysis")
+    parser = argparse.ArgumentParser(
+        prog="myth-tpu", description="TPU-native EVM security analysis")
+    parser.add_argument("-v", type=int, default=2, metavar="LOG_LEVEL",
+                        help="log level 0-5")
     subparsers = parser.add_subparsers(dest="command")
+
+    analyze = subparsers.add_parser("analyze", aliases=["a"],
+                                    help="symbolically analyze a contract")
+    _add_analysis_args(analyze)
+
+    safe = subparsers.add_parser("safe-functions",
+                                 help="list functions with no detected issues")
+    _add_analysis_args(safe)
 
     disasm = subparsers.add_parser("disassemble", aliases=["d"],
                                    help="disassemble EVM bytecode")
-    disasm.add_argument("-c", "--code", help="hex bytecode", default=None)
-    disasm.add_argument("-f", "--codefile", help="file containing hex bytecode",
-                        default=None)
+    disasm.add_argument("-c", "--code", default=None)
+    disasm.add_argument("-f", "--codefile", default=None)
+    disasm.add_argument("-a", "--address", default=None)
+    disasm.add_argument("--rpc", default=None)
+    disasm.add_argument("--rpctls", action="store_true")
 
+    foundry = subparsers.add_parser("foundry", aliases=["f"],
+                                    help="analyze a foundry project")
+    _add_analysis_args(foundry)
+    foundry.add_argument("--project-root", default=".")
+
+    concolic = subparsers.add_parser(
+        "concolic", help="flip branches of a concrete transaction trace")
+    concolic.add_argument("input", help="ConcreteData json file")
+    concolic.add_argument("--branches", required=True,
+                          help="comma-separated JUMPI addresses to flip")
+    concolic.add_argument("--engine", default="oracle",
+                          choices=["oracle", "lockstep"],
+                          help="concrete replay engine (lockstep = batched "
+                               "TPU interpreter)")
+
+    read_storage = subparsers.add_parser(
+        "read-storage", help="read storage slots from a deployed contract")
+    read_storage.add_argument("address")
+    read_storage.add_argument("params", nargs="+",
+                              help="position | position length | "
+                                   "mapping position key...")
+    read_storage.add_argument("--rpc", default="localhost:8545")
+    read_storage.add_argument("--rpctls", action="store_true")
+
+    f2h = subparsers.add_parser("function-to-hash",
+                                help="keccak selector of a signature")
+    f2h.add_argument("signature")
+
+    h2a = subparsers.add_parser("hash-to-address",
+                                help="signature lookup for a 4-byte selector")
+    h2a.add_argument("hash")
+
+    subparsers.add_parser("list-detectors", help="list detection modules")
     subparsers.add_parser("version", help="print version")
 
-    args = parser.parse_args(argv)
-    if args.command in ("disassemble", "d"):
+    cli_args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=[logging.NOTSET, logging.CRITICAL, logging.ERROR,
+               logging.WARNING, logging.INFO,
+               logging.DEBUG][min(cli_args.v, 5)],
+        format="%(levelname)s:%(name)s: %(message)s")
+
+    if cli_args.command in ("analyze", "a"):
+        return _cmd_analyze(parser, cli_args)
+    if cli_args.command == "safe-functions":
+        return _cmd_analyze(parser, cli_args, safe_functions=True)
+    if cli_args.command in ("foundry", "f"):
+        from ..mythril import MythrilAnalyzer, MythrilDisassembler
+
+        disassembler = MythrilDisassembler()
+        disassembler.load_from_foundry(cli_args.project_root)
+        cli_args.disable_iprof = not cli_args.enable_iprof
+        analyzer = MythrilAnalyzer(disassembler, cmd_args=cli_args,
+                                   strategy=cli_args.strategy)
+        report = analyzer.fire_lasers(
+            modules=cli_args.modules.split(",") if cli_args.modules else None,
+            transaction_count=cli_args.transaction_count)
+        print(_format_report(report, cli_args.outform))
+        return 1 if report.issues else 0
+    if cli_args.command in ("disassemble", "d"):
         from ..frontends import Disassembly
 
-        code = args.code
-        if code is None and args.codefile:
-            with open(args.codefile) as handle:
+        code = cli_args.code
+        if code is None and cli_args.codefile:
+            with open(cli_args.codefile) as handle:
                 code = handle.read().strip()
+        if code is None and cli_args.address:
+            disassembler = _build_disassembler(cli_args)
+            _, contract = disassembler.load_from_address(cli_args.address)
+            code = contract.code
         if not code:
-            parser.error("provide -c or -f")
+            parser.error("provide -c, -f or -a")
         sys.stdout.write(Disassembly(code).get_easm())
         return 0
-    if args.command == "version":
+    if cli_args.command == "concolic":
+        from ..concolic.concolic_execution import concolic_execution
+
+        with open(cli_args.input) as handle:
+            concrete_data = json.load(handle)
+        branches = [int(b, 0) for b in cli_args.branches.split(",")]
+        flipped = concolic_execution(concrete_data, branches,
+                                     engine=cli_args.engine)
+        print(json.dumps(flipped, indent=2))
+        return 0
+    if cli_args.command == "read-storage":
+        disassembler = _build_disassembler(cli_args)
+        print(disassembler.get_state_variable_from_storage(
+            cli_args.address, cli_args.params))
+        return 0
+    if cli_args.command == "function-to-hash":
+        from ..mythril import MythrilDisassembler
+
+        print(MythrilDisassembler.hash_for_function_signature(
+            cli_args.signature))
+        return 0
+    if cli_args.command == "hash-to-address":
+        from ..support.signatures import SignatureDB
+
+        for name in SignatureDB().get(cli_args.hash) or ["unknown"]:
+            print(name)
+        return 0
+    if cli_args.command == "list-detectors":
+        from ..analysis.module import ModuleLoader
+
+        for module in ModuleLoader().get_detection_modules():
+            print(f"{module.__class__.__name__}: {module.name} "
+                  f"(SWC-{module.swc_id})")
+        return 0
+    if cli_args.command == "version":
         print(f"myth-tpu {__version__}")
         return 0
     parser.print_help()

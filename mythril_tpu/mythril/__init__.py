@@ -1,0 +1,8 @@
+"""Orchestration layer (capability parity: mythril/mythril/ —
+MythrilDisassembler:43, MythrilAnalyzer:29, MythrilConfig:18)."""
+
+from .mythril_analyzer import MythrilAnalyzer
+from .mythril_config import MythrilConfig
+from .mythril_disassembler import MythrilDisassembler
+
+__all__ = ["MythrilAnalyzer", "MythrilConfig", "MythrilDisassembler"]

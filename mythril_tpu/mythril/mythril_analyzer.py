@@ -1,0 +1,137 @@
+"""MythrilAnalyzer: run the symbolic engine + detectors and build the Report
+(capability parity: mythril/mythril/mythril_analyzer.py:29 — fire_lasers:133,
+graph_html, dump_statespace; argparse values snapshot into the Args singleton
+exactly once here, mirroring the reference's flow :66-85)."""
+
+from __future__ import annotations
+
+import logging
+import traceback
+from typing import List, Optional
+
+from ..analysis.report import Issue, Report
+from ..analysis.security import fire_lasers, retrieve_callback_issues
+from ..analysis.symbolic import SymExecWrapper
+from ..smt.solver.solver_statistics import SolverStatistics
+from ..support.support_args import args
+from ..support.loader import DynLoader
+
+log = logging.getLogger(__name__)
+
+
+class MythrilAnalyzer:
+    def __init__(self, disassembler, cmd_args=None, strategy: str = "bfs",
+                 address: Optional[str] = None):
+        self.eth = disassembler.eth
+        self.contracts = disassembler.contracts or []
+        self.enable_online_lookup = disassembler.enable_online_lookup
+        self.strategy = strategy
+        self.address = address
+
+        cmd = cmd_args or _Namespace()
+        self.use_onchain_data = not getattr(cmd, "no_onchain_data", True)
+        self.execution_timeout = getattr(cmd, "execution_timeout", 600)
+        self.loop_bound = getattr(cmd, "loop_bound", 3)
+        self.create_timeout = getattr(cmd, "create_timeout", 10)
+        self.max_depth = getattr(cmd, "max_depth", 128)
+        self.disable_dependency_pruning = getattr(
+            cmd, "disable_dependency_pruning", False)
+        self.custom_modules_directory = getattr(
+            cmd, "custom_modules_directory", "")
+        # snapshot flags into the global Args singleton (reference :66-85)
+        args.pruning_factor = getattr(cmd, "pruning_factor", None)
+        args.solver_timeout = getattr(cmd, "solver_timeout", 10000)
+        args.parallel_solving = getattr(cmd, "parallel_solving", False)
+        args.unconstrained_storage = getattr(cmd, "unconstrained_storage",
+                                             False)
+        args.call_depth_limit = getattr(cmd, "call_depth_limit", 3)
+        args.disable_iprof = getattr(cmd, "disable_iprof", True)
+        args.solver_log = getattr(cmd, "solver_log", None)
+        args.transaction_sequences = getattr(cmd, "transaction_sequences",
+                                             None)
+        solver = getattr(cmd, "solver", None)
+        if solver:
+            args.solver = solver
+
+    def _dynloader(self):
+        if self.use_onchain_data and self.eth is not None:
+            return DynLoader(self.eth)
+        return None
+
+    # -- entry points ------------------------------------------------------------------
+    def dump_statespace(self, contract=None, transaction_count: int = 2) -> str:
+        from ..analysis.traceexplore import get_serializable_statespace
+        import json
+
+        contract = contract or self.contracts[0]
+        sym = SymExecWrapper(
+            contract, self.address, self.strategy,
+            dynloader=self._dynloader(), max_depth=self.max_depth,
+            execution_timeout=self.execution_timeout,
+            transaction_count=transaction_count,
+            create_timeout=self.create_timeout,
+            disable_dependency_pruning=self.disable_dependency_pruning,
+            run_analysis_modules=False)
+        return json.dumps(get_serializable_statespace(sym))
+
+    def graph_html(self, contract=None, transaction_count: int = 2,
+                   enable_physics: bool = False) -> str:
+        from ..analysis.callgraph import generate_graph
+
+        contract = contract or self.contracts[0]
+        sym = SymExecWrapper(
+            contract, self.address, self.strategy,
+            dynloader=self._dynloader(), max_depth=self.max_depth,
+            execution_timeout=self.execution_timeout,
+            transaction_count=transaction_count,
+            create_timeout=self.create_timeout,
+            disable_dependency_pruning=self.disable_dependency_pruning,
+            run_analysis_modules=False)
+        return generate_graph(sym, physics=enable_physics)
+
+    def fire_lasers(self, modules: Optional[List[str]] = None,
+                    transaction_count: int = 2) -> Report:
+        """Run detection on every loaded contract (reference :133-200)."""
+        all_issues: List[Issue] = []
+        exceptions = []
+        for contract in self.contracts:
+            SolverStatistics().reset()
+            try:
+                sym = SymExecWrapper(
+                    contract,
+                    self.address,
+                    self.strategy,
+                    dynloader=self._dynloader(),
+                    max_depth=self.max_depth,
+                    execution_timeout=self.execution_timeout,
+                    loop_bound=self.loop_bound,
+                    create_timeout=self.create_timeout,
+                    transaction_count=transaction_count,
+                    modules=modules,
+                    compulsory_statespace=False,
+                    disable_dependency_pruning=self.disable_dependency_pruning,
+                    custom_modules_directory=self.custom_modules_directory)
+                issues = fire_lasers(sym, modules)
+            except KeyboardInterrupt:
+                log.critical("analysis interrupted, saving issues found so far")
+                issues = retrieve_callback_issues(modules)
+            except Exception:
+                log.exception("exception during %s analysis", contract.name)
+                exceptions.append(traceback.format_exc())
+                issues = retrieve_callback_issues(modules)
+            log.info("solver statistics: %s", SolverStatistics())
+            for issue in issues:
+                issue.add_code_info(contract)
+            all_issues.extend(issues)
+
+        source_data = [getattr(c, "input_file", c.name)
+                       for c in self.contracts]
+        report = Report(contracts=self.contracts, exceptions=exceptions)
+        report.source = source_data
+        for issue in all_issues:
+            report.append_issue(issue)
+        return report
+
+
+class _Namespace:
+    pass

@@ -1,0 +1,52 @@
+"""Persistent configuration (capability parity: mythril/mythril/mythril_config.py:18
+— ~/.mythril/config.ini with an [defaults] RPC section, env overrides, and
+`set_api_rpc*` helpers that build the JSON-RPC client)."""
+
+from __future__ import annotations
+
+import configparser
+import logging
+import os
+from pathlib import Path
+from typing import Optional
+
+from ..ethereum.rpc import EthJsonRpc
+
+log = logging.getLogger(__name__)
+
+
+class MythrilConfig:
+    def __init__(self, config_path: Optional[str] = None):
+        self.mythril_dir = Path(os.environ.get(
+            "MYTHRIL_TPU_DIR", Path.home() / ".mythril-tpu"))
+        self.config_path = Path(config_path) if config_path else \
+            self.mythril_dir / "config.ini"
+        self.config = configparser.ConfigParser()
+        self.eth: Optional[EthJsonRpc] = None
+        self._load()
+
+    def _load(self) -> None:
+        if self.config_path.exists():
+            self.config.read(self.config_path)
+        if not self.config.has_section("defaults"):
+            self.config.add_section("defaults")
+
+    def save(self) -> None:
+        self.mythril_dir.mkdir(parents=True, exist_ok=True)
+        with open(self.config_path, "w") as handle:
+            self.config.write(handle)
+
+    # -- RPC selection ---------------------------------------------------------------
+    def set_api_rpc(self, rpc: Optional[str] = None,
+                    rpctls: bool = False) -> None:
+        rpc = rpc or os.environ.get("MYTHRIL_TPU_RPC") or \
+            self.config.get("defaults", "dynamic_loading",
+                            fallback="infura-mainnet")
+        self.eth = EthJsonRpc.from_preset(rpc, rpctls)
+        log.info("using RPC endpoint %s", self.eth.url)
+
+    def set_api_rpc_infura(self, network: str = "mainnet") -> None:
+        self.set_api_rpc(f"infura-{network}")
+
+    def set_api_rpc_localhost(self) -> None:
+        self.set_api_rpc("localhost:8545")

@@ -17,6 +17,21 @@ Everything here is JAX; `jax_enable_x64` is switched on at import because gas
 counters exceed 2^32 (word arithmetic itself never needs 64-bit lanes).
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# The lockstep step function is a large graph (division ladders, keccak rounds)
+# that takes ~2 min to compile on a remote-compile TPU path; persist compiled
+# executables so repeat runs (bench, CLI) skip straight to execution.
+_cache_dir = os.environ.get(
+    "MYTHRIL_TPU_JAX_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "mythril_tpu_jax"))
+try:
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+except Exception:  # cache is an optimization, never a hard requirement
+    pass

@@ -1,0 +1,217 @@
+"""StateBatch: the frontier as a structure-of-arrays pytree.
+
+This is SURVEY §7's design center: where the host engine keeps a Python
+worklist of `GlobalState` objects (`core/svm.py:61`), the TPU lane keeps ONE
+dense pytree whose leading axis is the lane (= state) axis. Forking, pruning
+and scheduling become masked tensor ops; sharding the lane axis over a
+`jax.sharding.Mesh` gives multi-chip data parallelism with zero code change to
+the step function.
+
+All capacities are static (XLA shapes): stack slots S, memory bytes M, code
+bytes C, calldata D, return-data R, storage slots K. A lane that outgrows any
+capacity sets status=ESCAPE and is handed back to the host oracle
+(`core/instructions.py`) — the same split the reference uses between symbolic
+execution and concrete host services (natives, RPC), applied to capacity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import words
+
+# lane status values
+RUNNING, STOPPED, RETURNED, REVERTED, ERRORED, ESCAPED = 0, 1, 2, 3, 4, 5
+
+STATUS_NAMES = {
+    RUNNING: "running", STOPPED: "stop", RETURNED: "return",
+    REVERTED: "revert", ERRORED: "error", ESCAPED: "escape",
+}
+
+
+class StateBatch(NamedTuple):
+    """All-lanes EVM machine state. Leading axis of every field is the lane axis."""
+
+    # machine
+    stack: jnp.ndarray        # uint32[B, S, 16]
+    sp: jnp.ndarray           # int32[B] — number of occupied slots
+    pc: jnp.ndarray           # int32[B] — byte offset into code
+    gas_used: jnp.ndarray     # int64[B] — lower-bound gas accounting
+    gas_limit: jnp.ndarray    # int64[B]
+    status: jnp.ndarray       # int32[B]
+    # memory
+    memory: jnp.ndarray       # uint8[B, M]
+    msize: jnp.ndarray        # int32[B] — active size in bytes (multiple of 32)
+    # code
+    code: jnp.ndarray         # uint8[B, C]
+    code_len: jnp.ndarray     # int32[B]
+    jumpdest: jnp.ndarray     # bool[B, C]
+    # calldata
+    calldata: jnp.ndarray     # uint8[B, D]
+    calldata_len: jnp.ndarray # int32[B]
+    # return buffer (RETURN/REVERT payload)
+    retdata: jnp.ndarray      # uint8[B, R]
+    retdata_len: jnp.ndarray  # int32[B]
+    # storage: linear-probe table of (key, value) words
+    storage_keys: jnp.ndarray # uint32[B, K, 16]
+    storage_vals: jnp.ndarray # uint32[B, K, 16]
+    storage_used: jnp.ndarray # bool[B, K]
+    # transient storage (EIP-1153), same layout
+    tstore_keys: jnp.ndarray  # uint32[B, T, 16]
+    tstore_vals: jnp.ndarray  # uint32[B, T, 16]
+    tstore_used: jnp.ndarray  # bool[B, T]
+    # environment (words)
+    address: jnp.ndarray
+    caller: jnp.ndarray
+    origin: jnp.ndarray
+    callvalue: jnp.ndarray
+    gasprice: jnp.ndarray
+    coinbase: jnp.ndarray
+    timestamp: jnp.ndarray
+    number: jnp.ndarray
+    prevrandao: jnp.ndarray
+    block_gaslimit: jnp.ndarray
+    chainid: jnp.ndarray
+    basefee: jnp.ndarray
+    selfbalance: jnp.ndarray
+
+    @property
+    def n_lanes(self) -> int:
+        return self.stack.shape[0]
+
+
+class LaneSpec:
+    """Host-side description of one execution (one VMTest / one concolic replay)."""
+
+    def __init__(self, code: bytes, calldata: bytes = b"",
+                 storage: Optional[Dict[int, int]] = None,
+                 gas_limit: int = 10_000_000, address: int = 0,
+                 caller: int = 0, origin: int = 0, callvalue: int = 0,
+                 gasprice: int = 0, coinbase: int = 0, timestamp: int = 0,
+                 number: int = 0, prevrandao: int = 0,
+                 block_gaslimit: int = 0, chainid: int = 1, basefee: int = 0,
+                 selfbalance: int = 0):
+        self.code = code
+        self.calldata = calldata
+        self.storage = dict(storage or {})
+        self.gas_limit = gas_limit
+        self.address = address
+        self.caller = caller
+        self.origin = origin
+        self.callvalue = callvalue
+        self.gasprice = gasprice
+        self.coinbase = coinbase
+        self.timestamp = timestamp
+        self.number = number
+        self.prevrandao = prevrandao
+        self.block_gaslimit = block_gaslimit
+        self.chainid = chainid
+        self.basefee = basefee
+        self.selfbalance = selfbalance
+
+
+def _jumpdest_bitmap(code: bytes, capacity: int) -> np.ndarray:
+    """Valid JUMPDEST byte offsets (0x5b outside PUSH immediates)."""
+    bitmap = np.zeros(capacity, dtype=bool)
+    i = 0
+    while i < len(code):
+        op = code[i]
+        if op == 0x5B:
+            bitmap[i] = True
+        if 0x60 <= op <= 0x7F:
+            i += op - 0x5F
+        i += 1
+    return bitmap
+
+
+def _word_rows(values) -> np.ndarray:
+    return np.stack([np.asarray(words.from_int(v)) for v in values])
+
+
+def build_batch(specs, stack_slots: int = 96, memory_bytes: int = 4096,
+                calldata_bytes: int = 512, retdata_bytes: int = 512,
+                storage_slots: int = 64, tstore_slots: int = 8) -> StateBatch:
+    """Pack host LaneSpecs into one dense StateBatch."""
+    n = len(specs)
+    code_cap = max(1, max(len(s.code) for s in specs))
+    calldata_cap = max(calldata_bytes, max(len(s.calldata) for s in specs))
+
+    code = np.zeros((n, code_cap), dtype=np.uint8)
+    jumpdest = np.zeros((n, code_cap), dtype=bool)
+    code_len = np.zeros(n, dtype=np.int32)
+    calldata = np.zeros((n, calldata_cap), dtype=np.uint8)
+    calldata_len = np.zeros(n, dtype=np.int32)
+    storage_keys = np.zeros((n, storage_slots, words.NLIMBS), dtype=np.uint32)
+    storage_vals = np.zeros((n, storage_slots, words.NLIMBS), dtype=np.uint32)
+    storage_used = np.zeros((n, storage_slots), dtype=bool)
+    gas_limit = np.zeros(n, dtype=np.int64)
+
+    env_fields = ["address", "caller", "origin", "callvalue", "gasprice",
+                  "coinbase", "timestamp", "number", "prevrandao",
+                  "block_gaslimit", "chainid", "basefee", "selfbalance"]
+    env = {f: np.zeros((n, words.NLIMBS), dtype=np.uint32) for f in env_fields}
+
+    for i, spec in enumerate(specs):
+        code[i, :len(spec.code)] = np.frombuffer(spec.code, dtype=np.uint8)
+        code_len[i] = len(spec.code)
+        jumpdest[i] = _jumpdest_bitmap(spec.code, code_cap)
+        calldata[i, :len(spec.calldata)] = np.frombuffer(spec.calldata,
+                                                         dtype=np.uint8)
+        calldata_len[i] = len(spec.calldata)
+        if len(spec.storage) > storage_slots:
+            raise ValueError("initial storage exceeds storage_slots")
+        for slot_index, (key, value) in enumerate(sorted(spec.storage.items())):
+            storage_keys[i, slot_index] = np.asarray(words.from_int(key))
+            storage_vals[i, slot_index] = np.asarray(words.from_int(value))
+            storage_used[i, slot_index] = True
+        gas_limit[i] = min(spec.gas_limit, 2**62)
+        for field in env_fields:
+            env[field][i] = np.asarray(words.from_int(getattr(spec, field)))
+
+    return StateBatch(
+        stack=jnp.zeros((n, stack_slots, words.NLIMBS), dtype=jnp.uint32),
+        sp=jnp.zeros(n, dtype=jnp.int32),
+        pc=jnp.zeros(n, dtype=jnp.int32),
+        gas_used=jnp.zeros(n, dtype=jnp.int64),
+        gas_limit=jnp.asarray(gas_limit),
+        status=jnp.zeros(n, dtype=jnp.int32),
+        memory=jnp.zeros((n, memory_bytes), dtype=jnp.uint8),
+        msize=jnp.zeros(n, dtype=jnp.int32),
+        code=jnp.asarray(code),
+        code_len=jnp.asarray(code_len),
+        jumpdest=jnp.asarray(jumpdest),
+        calldata=jnp.asarray(calldata),
+        calldata_len=jnp.asarray(calldata_len),
+        retdata=jnp.zeros((n, retdata_bytes), dtype=jnp.uint8),
+        retdata_len=jnp.zeros(n, dtype=jnp.int32),
+        storage_keys=jnp.asarray(storage_keys),
+        storage_vals=jnp.asarray(storage_vals),
+        storage_used=jnp.asarray(storage_used),
+        tstore_keys=jnp.zeros((n, tstore_slots, words.NLIMBS), dtype=jnp.uint32),
+        tstore_vals=jnp.zeros((n, tstore_slots, words.NLIMBS), dtype=jnp.uint32),
+        tstore_used=jnp.zeros((n, tstore_slots), dtype=bool),
+        **{f: jnp.asarray(env[f]) for f in env_fields},
+    )
+
+
+def extract_storage(state: StateBatch, lane: int) -> Dict[int, int]:
+    """Host-side: read one lane's storage table back into a dict."""
+    used = np.asarray(state.storage_used[lane])
+    keys = words.to_ints(state.storage_keys[lane])
+    vals = words.to_ints(state.storage_vals[lane])
+    return {int(keys[i]): int(vals[i]) for i in range(len(used)) if used[i]}
+
+
+def extract_stack(state: StateBatch, lane: int):
+    """Host-side: one lane's stack, bottom first."""
+    depth = int(state.sp[lane])
+    vals = words.to_ints(state.stack[lane, :depth])
+    return [int(v) for v in np.atleast_1d(vals)] if depth else []
+
+
+def extract_retdata(state: StateBatch, lane: int) -> bytes:
+    length = int(state.retdata_len[lane])
+    return bytes(np.asarray(state.retdata[lane, :length]).tolist())

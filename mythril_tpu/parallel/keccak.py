@@ -1,0 +1,162 @@
+"""Batched keccak-256 entirely on device.
+
+The sponge state is 25 64-bit lanes held as uint32 (lo, hi) pairs so every
+operation is a native 32-bit rotate/xor — no emulated 64-bit arithmetic, which
+keeps the permutation on the TPU's vector units. Rotation amounts are all
+static, so each round compiles to a fixed xor/or/shift DAG that XLA fuses.
+
+Used by the lockstep interpreter for SHA3/CREATE2 (reference semantics:
+mythril/laser/ethereum/instructions.py sha3_:1018 concretizes via eth-hash on
+host; here concrete lanes hash on device, batched).
+
+Variable-length batched hashing: each lane carries its own byte length; padding
+(0x01 … 0x80) is materialized arithmetically per lane and absorption of block
+`b` is masked by `b < nblocks(lane)`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U32 = jnp.uint32
+RATE = 136  # keccak-256 rate in bytes
+LANES = RATE // 8  # 17 input lanes per block
+
+_ROUND_CONSTANTS = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+_RC_LO = np.array([c & 0xFFFFFFFF for c in _ROUND_CONSTANTS], dtype=np.uint32)
+_RC_HI = np.array([c >> 32 for c in _ROUND_CONSTANTS], dtype=np.uint32)
+
+# rotation offsets r[x][y], flattened index = x + 5*y
+_ROTATIONS = np.zeros(25, dtype=np.int32)
+_ROT_TABLE = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+for _x in range(5):
+    for _y in range(5):
+        _ROTATIONS[_x + 5 * _y] = _ROT_TABLE[_x][_y]
+
+
+def _rotl64(lo, hi, n):
+    """Rotate a 64-bit value given as uint32 (lo, hi) left by static n."""
+    n %= 64
+    if n == 0:
+        return lo, hi
+    if n == 32:
+        return hi, lo
+    if n < 32:
+        new_lo = ((lo << U32(n)) | (hi >> U32(32 - n)))
+        new_hi = ((hi << U32(n)) | (lo >> U32(32 - n)))
+        return new_lo, new_hi
+    m = n - 32
+    new_lo = ((hi << U32(m)) | (lo >> U32(32 - m)))
+    new_hi = ((lo << U32(m)) | (hi >> U32(32 - m)))
+    return new_lo, new_hi
+
+
+def keccak_f(lo: jnp.ndarray, hi: jnp.ndarray):
+    """keccak-f[1600] permutation. lo/hi: uint32[..., 25]."""
+    for round_index in range(24):
+        # theta
+        c_lo = [lo[..., x] ^ lo[..., x + 5] ^ lo[..., x + 10]
+                ^ lo[..., x + 15] ^ lo[..., x + 20] for x in range(5)]
+        c_hi = [hi[..., x] ^ hi[..., x + 5] ^ hi[..., x + 10]
+                ^ hi[..., x + 15] ^ hi[..., x + 20] for x in range(5)]
+        d_lo, d_hi = [], []
+        for x in range(5):
+            rot_lo, rot_hi = _rotl64(c_lo[(x + 1) % 5], c_hi[(x + 1) % 5], 1)
+            d_lo.append(c_lo[(x + 4) % 5] ^ rot_lo)
+            d_hi.append(c_hi[(x + 4) % 5] ^ rot_hi)
+        lo = jnp.stack([lo[..., i] ^ d_lo[i % 5] for i in range(25)], axis=-1)
+        hi = jnp.stack([hi[..., i] ^ d_hi[i % 5] for i in range(25)], axis=-1)
+
+        # rho + pi
+        b_lo = [None] * 25
+        b_hi = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                src = x + 5 * y
+                dst = y + 5 * ((2 * x + 3 * y) % 5)
+                b_lo[dst], b_hi[dst] = _rotl64(
+                    lo[..., src], hi[..., src], int(_ROTATIONS[src]))
+
+        # chi
+        new_lo, new_hi = [], []
+        for y in range(5):
+            for x in range(5):
+                i = x + 5 * y
+                i1 = (x + 1) % 5 + 5 * y
+                i2 = (x + 2) % 5 + 5 * y
+                new_lo.append(b_lo[i] ^ ((~b_lo[i1]) & b_lo[i2]))
+                new_hi.append(b_hi[i] ^ ((~b_hi[i1]) & b_hi[i2]))
+        lo = jnp.stack(new_lo, axis=-1)
+        hi = jnp.stack(new_hi, axis=-1)
+
+        # iota
+        lo = lo.at[..., 0].set(lo[..., 0] ^ U32(_RC_LO[round_index]))
+        hi = hi.at[..., 0].set(hi[..., 0] ^ U32(_RC_HI[round_index]))
+    return lo, hi
+
+
+def keccak256(data: jnp.ndarray, length: jnp.ndarray) -> jnp.ndarray:
+    """Batched keccak-256.
+
+    data:   uint8[..., max_len] message buffer (bytes past `length` ignored)
+    length: int32[...] per-lane message length in bytes, 0 <= length <= max_len
+    returns uint8[..., 32] digests.
+    """
+    batch_shape = data.shape[:-1]
+    max_len = data.shape[-1]
+    n_blocks = (max_len + 1 + RATE - 1) // RATE
+    padded_size = n_blocks * RATE
+
+    j = jnp.arange(padded_size)
+    padded_len = ((length + 1 + RATE - 1) // RATE) * RATE
+    base = jnp.where(j < length[..., None],
+                     jnp.pad(data, [(0, 0)] * len(batch_shape)
+                             + [(0, padded_size - max_len)]),
+                     0).astype(jnp.uint8)
+    base = jnp.where(j == length[..., None], jnp.uint8(0x01), base)
+    base = jnp.where(j == padded_len[..., None] - 1,
+                     base | jnp.uint8(0x80), base)
+
+    # bytes -> 64-bit lanes (little-endian within each lane)
+    blocks = base.reshape(batch_shape + (n_blocks, LANES, 8)).astype(U32)
+    weights = (U32(1) << (8 * jnp.arange(4, dtype=U32)))
+    block_lo = jnp.sum(blocks[..., 0:4] * weights, axis=-1, dtype=U32)
+    block_hi = jnp.sum(blocks[..., 4:8] * weights, axis=-1, dtype=U32)
+
+    lo = jnp.zeros(batch_shape + (25,), dtype=U32)
+    hi = jnp.zeros(batch_shape + (25,), dtype=U32)
+    lane_blocks = padded_len // RATE
+    pad_lanes = jnp.zeros(batch_shape + (25 - LANES,), dtype=U32)
+    for b in range(n_blocks):
+        absorb_lo = jnp.concatenate([block_lo[..., b, :], pad_lanes], axis=-1)
+        absorb_hi = jnp.concatenate([block_hi[..., b, :], pad_lanes], axis=-1)
+        new_lo, new_hi = keccak_f(lo ^ absorb_lo, hi ^ absorb_hi)
+        active = (b < lane_blocks)[..., None]
+        lo = jnp.where(active, new_lo, lo)
+        hi = jnp.where(active, new_hi, hi)
+
+    # squeeze 32 bytes from lanes 0..3
+    out_lanes_lo = lo[..., 0:4]
+    out_lanes_hi = hi[..., 0:4]
+    shifts = 8 * jnp.arange(4, dtype=U32)
+    lo_bytes = ((out_lanes_lo[..., None] >> shifts) & 0xFF).astype(jnp.uint8)
+    hi_bytes = ((out_lanes_hi[..., None] >> shifts) & 0xFF).astype(jnp.uint8)
+    return jnp.concatenate([lo_bytes, hi_bytes], axis=-1) \
+        .reshape(batch_shape + (32,))

@@ -1,0 +1,609 @@
+"""The lockstep batched EVM interpreter: one jitted step for every lane at once.
+
+Design (SURVEY §7 stage 7): instead of the host engine's
+one-state-at-a-time `execute_state` (core/svm.py:196), every lane of a
+StateBatch fetches its own opcode and all opcode families are evaluated as
+masked vector ops over the whole batch — the TPU analogue of a warp stepping
+divergent threads. Cheap families (arithmetic, stack, env) are always computed
+and mask-selected; expensive families (division ladder, EXP, keccak, storage
+table scans, memory traffic) are gated behind `lax.cond(any-lane-needs-it)` so
+a batch that never divides never pays for the divider.
+
+Semantics referee: `core/instructions.py` (which passes the Ethereum
+Foundation VMTests). Gas accounting matches the oracle's *lower bound* model:
+static min gas per opcode (ops/opcodes.py) plus quadratic memory-expansion gas
+(core/state/machine_state.py:75) — certainly-OOG lanes die exactly like the
+oracle's check_gas. Ops the batch cannot express (CALL family, CREATE,
+EXTCODE*, cross-account reads, capacity overruns) set status=ESCAPED and the
+lane is finished on the host oracle; `tests/test_parallel_lockstep.py` checks
+lane-for-lane agreement on the VMTests corpus.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.opcodes import ADDRESS, GAS, OPCODES, STACK
+from . import keccak, words
+from .batch import (ERRORED, ESCAPED, RETURNED, REVERTED, RUNNING, STOPPED,
+                    StateBatch)
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+
+# -- static opcode tables -------------------------------------------------------------
+
+O = {name: meta[ADDRESS] for name, meta in OPCODES.items()}
+
+POPS = np.zeros(256, dtype=np.int32)
+PUSHES = np.zeros(256, dtype=np.int32)
+GAS_MIN = np.zeros(256, dtype=np.int64)
+VALID = np.zeros(256, dtype=bool)
+for _name, _meta in OPCODES.items():
+    _byte = _meta[ADDRESS]
+    VALID[_byte] = True
+    POPS[_byte] = _meta[STACK][0]
+    PUSHES[_byte] = _meta[STACK][1]
+    GAS_MIN[_byte] = _meta[GAS][0]
+
+# ops the lockstep engine hands back to the host oracle
+ESCAPE_OPS = np.zeros(256, dtype=bool)
+for _name in ["CALL", "CALLCODE", "DELEGATECALL", "STATICCALL", "CREATE",
+              "CREATE2", "SELFDESTRUCT", "EXTCODESIZE", "EXTCODECOPY",
+              "EXTCODEHASH", "BLOCKHASH", "BALANCE", "LOG0", "LOG1", "LOG2",
+              "LOG3", "LOG4"]:
+    if _name in O:
+        ESCAPE_OPS[O[_name]] = True
+# note: LOGs escape because the lockstep engine does not record logs; the
+# oracle's log_ only pops, so escaping keeps trace parity cheap to verify.
+
+_JTAB = jnp.asarray  # shorthand
+
+POPS_T = _JTAB(POPS)
+PUSHES_T = _JTAB(PUSHES)
+GAS_MIN_T = _JTAB(GAS_MIN)
+VALID_T = _JTAB(VALID)
+ESCAPE_T = _JTAB(ESCAPE_OPS)
+
+SHA3_MAX = 512       # max on-device keccak input per lane (bytes)
+COPY_MAX = 512       # max bytes moved per copy instruction on device
+
+
+def _i32_to_word(x: jnp.ndarray) -> jnp.ndarray:
+    """Non-negative int32/int64 scalar per lane -> word."""
+    x = x.astype(jnp.int64)
+    limbs = [(x >> (16 * i)) & 0xFFFF for i in range(4)]
+    out = jnp.stack(limbs + [jnp.zeros_like(x)] * (words.NLIMBS - 4),
+                    axis=-1).astype(U32)
+    return out
+
+
+def _word_to_i64(word: jnp.ndarray):
+    """Word -> (int64 value of low 64 bits, fits_flag). fits_flag false when any
+    bit >= 2^32 is set (oracle treats such memory offsets as certain OOG)."""
+    low = (word[..., 0].astype(jnp.int64)
+           | (word[..., 1].astype(jnp.int64) << 16))
+    fits = jnp.all(word[..., 2:] == 0, axis=-1)
+    return low, fits
+
+
+def _peek(state: StateBatch, n) -> jnp.ndarray:
+    """n-th word from the top (n=1 is top); n scalar or per-lane array."""
+    idx = jnp.clip(state.sp - n, 0, state.stack.shape[1] - 1)
+    return jnp.take_along_axis(
+        state.stack, idx[:, None, None].astype(I32), axis=1)[:, 0, :]
+
+
+def _mem_read(memory, msize, offset, nbytes_static):
+    """Gather nbytes_static bytes at per-lane offset; bytes beyond msize read 0."""
+    m = memory.shape[1]
+    j = jnp.arange(nbytes_static)
+    idx = offset[:, None] + j
+    vals = jnp.take_along_axis(memory, jnp.clip(idx, 0, m - 1).astype(I32),
+                               axis=1)
+    return jnp.where((idx >= 0) & (idx < msize[:, None]), vals, 0)
+
+
+def _mem_write(memory, lane_mask, offset, data, size=None):
+    """Masked scatter of data[B, n] to memory[lane, offset:offset+n]."""
+    m = memory.shape[1]
+    n = data.shape[1]
+    j = jnp.arange(n)
+    idx = jnp.clip(offset[:, None] + j, 0, m - 1).astype(I32)
+    current = jnp.take_along_axis(memory, idx, axis=1)
+    write = lane_mask[:, None]
+    if size is not None:
+        write = write & (j < size[:, None])
+    vals = jnp.where(write, data, current)
+    return jnp.put_along_axis(memory, idx, vals, axis=1, inplace=False)
+
+
+def _table_get(keys, vals, used, key):
+    """(found[B], value[B,16]) for a (key,value) word table [B,K,16]."""
+    match = used & jnp.all(keys == key[:, None, :], axis=-1)
+    found = jnp.any(match, axis=-1)
+    value = jnp.sum(jnp.where(match[..., None], vals, U32(0)),
+                    axis=1, dtype=U32)
+    return found, value
+
+
+def _table_set(keys, vals, used, lane_mask, key, value):
+    """Insert/update key->value where lane_mask. Returns (keys, vals, used, full)."""
+    match = used & jnp.all(keys == key[:, None, :], axis=-1)
+    found = jnp.any(match, axis=-1)
+    match_idx = jnp.argmax(match, axis=-1)
+    free_idx = jnp.argmax(~used, axis=-1)
+    slot = jnp.where(found, match_idx, free_idx).astype(I32)
+    full = lane_mask & ~found & jnp.all(used, axis=-1)
+    do = lane_mask & ~full
+    lane = jnp.arange(keys.shape[0])
+    old_key = keys[lane, slot]
+    old_val = vals[lane, slot]
+    old_used = used[lane, slot]
+    keys = keys.at[lane, slot].set(jnp.where(do[:, None], key, old_key))
+    vals = vals.at[lane, slot].set(jnp.where(do[:, None], value, old_val))
+    used = used.at[lane, slot].set(jnp.where(do, True, old_used))
+    return keys, vals, used, full
+
+
+def step(state: StateBatch) -> StateBatch:
+    """Advance every running lane by one instruction."""
+    batch, slots = state.stack.shape[0], state.stack.shape[1]
+    mem_cap = state.memory.shape[1]
+    running = state.status == RUNNING
+    lane = jnp.arange(batch)
+
+    # ---- fetch ----------------------------------------------------------------------
+    in_code = state.pc < state.code_len
+    op = jnp.where(
+        in_code,
+        jnp.take_along_axis(state.code,
+                            jnp.clip(state.pc, 0, state.code.shape[1] - 1)
+                            [:, None], axis=1)[:, 0].astype(I32),
+        I32(O["STOP"]))
+
+    def is_op(name):
+        return op == O[name]
+
+    def op_in(*names):
+        mask = jnp.zeros_like(op, dtype=bool)
+        for name in names:
+            mask = mask | (op == O[name])
+        return mask
+
+    # ---- validity / stack preflight --------------------------------------------------
+    pops = POPS_T[op]
+    pushes = PUSHES_T[op]
+    invalid = ~VALID_T[op]
+    underflow = state.sp < pops
+    new_sp = state.sp - pops + pushes
+    overflow_cap = new_sp > slots          # engine capacity -> escape
+    overflow_evm = new_sp > 1024           # real EVM limit -> error
+    escape = ESCAPE_T[op]
+
+    # ---- operands --------------------------------------------------------------------
+    a = _peek(state, 1)
+    b = _peek(state, 2)
+    c = _peek(state, 3)
+
+    # ---- memory ranges + expansion gas ----------------------------------------------
+    # (off, size) of the memory range an op touches, else size 0
+    off_word = jnp.where(op_in("MLOAD", "MSTORE", "MSTORE8", "SHA3",
+                               "CALLDATACOPY", "CODECOPY", "RETURNDATACOPY",
+                               "RETURN", "REVERT")[:, None], a, 0)
+    size_is_c = op_in("CALLDATACOPY", "CODECOPY", "RETURNDATACOPY", "MCOPY")
+    size_is_b = op_in("SHA3", "RETURN", "REVERT")
+    size_word = jnp.where(size_is_c[:, None], c,
+                          jnp.where(size_is_b[:, None], b, 0))
+    fixed32 = op_in("MLOAD", "MSTORE")
+    fixed1 = is_op("MSTORE8")
+    # MCOPY extends to max(dst, src) + len
+    mcopy_off = jnp.where(words.lt(a, b)[:, None], b, a)
+    off_word = jnp.where(is_op("MCOPY")[:, None], mcopy_off, off_word)
+
+    off_i, off_fits = _word_to_i64(off_word)
+    size_i, size_fits = _word_to_i64(size_word)
+    size_i = jnp.where(fixed32, 32, jnp.where(fixed1, 1, size_i))
+    size_fits = size_fits | fixed32 | fixed1
+    touches_mem = size_i > 0
+    mem_end = off_i + size_i
+    mem_oog = touches_mem & (~off_fits | ~size_fits | (mem_end > 2 ** 32))
+    mem_escape = touches_mem & ~mem_oog & (mem_end > mem_cap)
+
+    ceil32 = lambda v: ((v + 31) // 32) * 32
+    after_bytes = jnp.maximum(state.msize.astype(I64), ceil32(mem_end))
+    after_bytes = jnp.where(touches_mem & ~mem_oog & ~mem_escape,
+                            after_bytes, state.msize.astype(I64))
+    before_w = state.msize.astype(I64) // 32
+    after_w = after_bytes // 32
+    mem_gas = jnp.where(after_w > before_w,
+                        3 * (after_w - before_w)
+                        + (after_w * after_w) // 512
+                        - (before_w * before_w) // 512,
+                        0)
+    new_msize = after_bytes.astype(I32)
+
+    # ---- gas (lower-bound model, parity with oracle accumulate_gas) ------------------
+    new_gas_used = state.gas_used + GAS_MIN_T[op] + mem_gas
+    oog = new_gas_used > state.gas_limit
+
+    # ---- cheap result candidates -----------------------------------------------------
+    zero_w = jnp.zeros_like(a)
+
+    # division ladder (gated: one shared divider for DIV/SDIV/MOD/SMOD)
+    div_like = running & op_in("DIV", "SDIV", "MOD", "SMOD")
+
+    def _div_family(_):
+        signed = op_in("SDIV", "SMOD")
+        sa = words.sign_bit(a) == 1
+        sb = words.sign_bit(b) == 1
+        na = jnp.where((signed & sa)[:, None], words.neg(a), a)
+        nb = jnp.where((signed & sb)[:, None], words.neg(b), b)
+        q, r = words._divmod_bits(na, nb, words.WORD_BITS)
+        sdiv_q = jnp.where((sa ^ sb)[:, None], words.neg(q), q)
+        smod_r = jnp.where(sa[:, None], words.neg(r), r)
+        res = jnp.where(is_op("DIV")[:, None], q,
+              jnp.where(is_op("MOD")[:, None], r,
+              jnp.where(is_op("SDIV")[:, None], sdiv_q, smod_r)))
+        return jnp.where(words.is_zero(b)[:, None], 0, res)
+
+    div_res = jax.lax.cond(jnp.any(div_like), _div_family,
+                           lambda _: zero_w, None)
+
+    addmod_mask = running & is_op("ADDMOD")
+    addmod_res = jax.lax.cond(jnp.any(addmod_mask),
+                              lambda _: words.addmod(a, b, c),
+                              lambda _: zero_w, None)
+    mulmod_mask = running & is_op("MULMOD")
+    mulmod_res = jax.lax.cond(jnp.any(mulmod_mask),
+                              lambda _: words.mulmod(a, b, c),
+                              lambda _: zero_w, None)
+    exp_mask = running & is_op("EXP")
+    exp_res = jax.lax.cond(jnp.any(exp_mask),
+                           lambda _: words.exp(a, b),
+                           lambda _: zero_w, None)
+    mul_mask = running & is_op("MUL")
+    mul_res = jax.lax.cond(jnp.any(mul_mask),
+                           lambda _: words.mul(a, b),
+                           lambda _: zero_w, None)
+
+    # keccak (gated)
+    sha_mask = running & is_op("SHA3")
+    sha_len_i, sha_len_fits = _word_to_i64(b)
+    sha_escape = sha_mask & (~sha_len_fits | (sha_len_i > SHA3_MAX))
+
+    def _sha3(_):
+        buf = _mem_read(state.memory, state.msize, off_i, SHA3_MAX)
+        digest = keccak.keccak256(buf, jnp.clip(sha_len_i, 0, SHA3_MAX)
+                                  .astype(I32))
+        return words.from_bytes(digest)
+
+    sha_res = jax.lax.cond(jnp.any(sha_mask & ~sha_escape), _sha3,
+                           lambda _: zero_w, None)
+
+    # storage / transient storage reads (gated)
+    sload_mask = running & is_op("SLOAD")
+
+    def _sload(_):
+        _, value = _table_get(state.storage_keys, state.storage_vals,
+                              state.storage_used, a)
+        return value
+
+    sload_res = jax.lax.cond(jnp.any(sload_mask), _sload,
+                             lambda _: zero_w, None)
+
+    tload_mask = running & is_op("TLOAD")
+
+    def _tload(_):
+        _, value = _table_get(state.tstore_keys, state.tstore_vals,
+                              state.tstore_used, a)
+        return value
+
+    tload_res = jax.lax.cond(jnp.any(tload_mask), _tload,
+                             lambda _: zero_w, None)
+
+    # MLOAD (gated)
+    mload_mask = running & is_op("MLOAD")
+    mload_res = jax.lax.cond(
+        jnp.any(mload_mask),
+        lambda _: words.from_bytes(_mem_read(state.memory, new_msize,
+                                             off_i, 32)),
+        lambda _: zero_w, None)
+
+    # CALLDATALOAD: 32-byte big-endian read, OOB zero-padded
+    cdl_off, cdl_fits = _word_to_i64(a)
+    j32 = jnp.arange(32)
+    cdl_idx = cdl_off[:, None] + j32
+    cdl_bytes = jnp.take_along_axis(
+        state.calldata,
+        jnp.clip(cdl_idx, 0, state.calldata.shape[1] - 1).astype(I32), axis=1)
+    cdl_bytes = jnp.where(
+        cdl_fits[:, None] & (cdl_idx < state.calldata_len[:, None]),
+        cdl_bytes, 0)
+    cdl_res = words.from_bytes(cdl_bytes)
+
+    # PUSH immediates: bytes code[pc+1 : pc+1+n], value right-aligned in 32 bytes
+    imm_len = jnp.clip(op - 0x5F, 0, 32)           # 0 for PUSH0
+    src = state.pc[:, None] + 1 + j32 - (32 - imm_len[:, None])
+    push_bytes = jnp.take_along_axis(
+        state.code, jnp.clip(src, 0, state.code.shape[1] - 1).astype(I32),
+        axis=1)
+    push_bytes = jnp.where((src >= state.pc[:, None] + 1)
+                           & (src < state.code_len[:, None]), push_bytes, 0)
+    push_res = words.from_bytes(push_bytes)
+
+    # DUPn: value at depth n
+    dup_n = jnp.clip(op - 0x7F, 1, 16)
+    dup_res = _peek(state, dup_n)
+
+    is_push = (op >= 0x5F) & (op <= 0x7F)
+    is_dup = (op >= 0x80) & (op <= 0x8F)
+    is_swap = (op >= 0x90) & (op <= 0x9F)
+
+    # ---- result select ---------------------------------------------------------------
+    def sel(acc, mask, cand):
+        return jnp.where(mask[:, None], cand, acc)
+
+    result = zero_w
+    result = sel(result, is_op("ADD"), words.add(a, b))
+    result = sel(result, is_op("SUB"), words.sub(a, b))
+    result = sel(result, mul_mask, mul_res)
+    result = sel(result, div_like, div_res)
+    result = sel(result, addmod_mask, addmod_res)
+    result = sel(result, mulmod_mask, mulmod_res)
+    result = sel(result, exp_mask, exp_res)
+    result = sel(result, is_op("SIGNEXTEND"), words.signextend(a, b))
+    result = sel(result, is_op("LT"), words.bool_to_word(words.lt(a, b)))
+    result = sel(result, is_op("GT"), words.bool_to_word(words.gt(a, b)))
+    result = sel(result, is_op("SLT"), words.bool_to_word(words.slt(a, b)))
+    result = sel(result, is_op("SGT"), words.bool_to_word(words.sgt(a, b)))
+    result = sel(result, is_op("EQ"), words.bool_to_word(words.eq(a, b)))
+    result = sel(result, is_op("ISZERO"),
+                 words.bool_to_word(words.is_zero(a)))
+    result = sel(result, is_op("AND"), a & b)
+    result = sel(result, is_op("OR"), a | b)
+    result = sel(result, is_op("XOR"), a ^ b)
+    result = sel(result, is_op("NOT"), words.bnot(a))
+    result = sel(result, is_op("BYTE"), words.byte_op(a, b))
+    result = sel(result, is_op("SHL"), words.shl(a, b))
+    result = sel(result, is_op("SHR"), words.shr(a, b))
+    result = sel(result, is_op("SAR"), words.sar(a, b))
+    result = sel(result, sha_mask, sha_res)
+    result = sel(result, is_op("ADDRESS"), state.address)
+    result = sel(result, is_op("ORIGIN"), state.origin)
+    result = sel(result, is_op("CALLER"), state.caller)
+    result = sel(result, is_op("CALLVALUE"), state.callvalue)
+    result = sel(result, is_op("CALLDATALOAD"), cdl_res)
+    result = sel(result, is_op("CALLDATASIZE"),
+                 _i32_to_word(state.calldata_len))
+    result = sel(result, is_op("CODESIZE"), _i32_to_word(state.code_len))
+    result = sel(result, is_op("GASPRICE"), state.gasprice)
+    result = sel(result, is_op("RETURNDATASIZE"),
+                 _i32_to_word(state.retdata_len))
+    result = sel(result, is_op("COINBASE"), state.coinbase)
+    result = sel(result, is_op("TIMESTAMP"), state.timestamp)
+    result = sel(result, is_op("NUMBER"), state.number)
+    result = sel(result, is_op("PREVRANDAO"), state.prevrandao)
+    result = sel(result, is_op("GASLIMIT"), state.block_gaslimit)
+    result = sel(result, is_op("CHAINID"), state.chainid)
+    result = sel(result, is_op("SELFBALANCE"), state.selfbalance)
+    result = sel(result, is_op("BASEFEE"), state.basefee)
+    result = sel(result, is_op("BLOBHASH"), zero_w)
+    result = sel(result, is_op("BLOBBASEFEE"), zero_w)
+    result = sel(result, is_op("PC"), _i32_to_word(state.pc))
+    result = sel(result, is_op("MSIZE"), _i32_to_word(new_msize))
+    result = sel(result, is_op("GAS"),
+                 _i32_to_word(jnp.maximum(state.gas_limit - new_gas_used, 0)))
+    result = sel(result, mload_mask, mload_res)
+    result = sel(result, sload_mask, sload_res)
+    result = sel(result, tload_mask, tload_res)
+    result = sel(result, is_push, push_res)
+    result = sel(result, is_dup, dup_res)
+
+    # ---- stack update ----------------------------------------------------------------
+    # every value-producing op writes `result` at the new top; DUPn has
+    # pushes = n+1 in the stack-effect table, so test >= 1, not == 1
+    writes_result = (pushes >= 1) & ~is_swap
+    write_idx = jnp.clip(new_sp - 1, 0, slots - 1)
+    old_top = state.stack[lane, write_idx]
+    new_stack = state.stack.at[lane, write_idx].set(
+        jnp.where((running & writes_result)[:, None], result, old_top))
+
+    # SWAPn: exchange top (sp-1) with (sp-1-n)
+    swap_n = jnp.clip(op - 0x8F, 1, 16)
+    swap_do = running & is_swap
+    top_idx = jnp.clip(state.sp - 1, 0, slots - 1)
+    deep_idx = jnp.clip(state.sp - 1 - swap_n, 0, slots - 1)
+    top_val = new_stack[lane, top_idx]
+    deep_val = new_stack[lane, deep_idx]
+    new_stack = new_stack.at[lane, top_idx].set(
+        jnp.where(swap_do[:, None], deep_val, top_val))
+    new_stack = new_stack.at[lane, deep_idx].set(
+        jnp.where(swap_do[:, None], top_val, deep_val))
+
+    # ---- memory writes (each family gated) -------------------------------------------
+    new_memory = state.memory
+
+    mstore_mask = running & is_op("MSTORE") & ~mem_oog & ~mem_escape
+    new_memory = jax.lax.cond(
+        jnp.any(mstore_mask),
+        lambda mem: _mem_write(mem, mstore_mask, off_i, words.to_bytes(b)),
+        lambda mem: mem, new_memory)
+
+    mstore8_mask = running & is_op("MSTORE8") & ~mem_oog & ~mem_escape
+    new_memory = jax.lax.cond(
+        jnp.any(mstore8_mask),
+        lambda mem: _mem_write(mem, mstore8_mask, off_i,
+                               (b[..., 0] & 0xFF).astype(jnp.uint8)[:, None]),
+        lambda mem: mem, new_memory)
+
+    # copies: CALLDATACOPY / CODECOPY / RETURNDATACOPY / MCOPY
+    copy_mask = running & op_in("CALLDATACOPY", "CODECOPY", "RETURNDATACOPY",
+                                "MCOPY") & ~mem_oog & ~mem_escape
+    copy_src_off, copy_src_fits = _word_to_i64(b)
+    copy_len = jnp.where(copy_mask, size_i, 0)
+    copy_escape = copy_mask & (copy_len > COPY_MAX)
+    copy_do = copy_mask & ~copy_escape
+
+    def _do_copy(mem):
+        jj = jnp.arange(COPY_MAX)
+        src_idx = copy_src_off[:, None] + jj
+        cd = jnp.take_along_axis(
+            state.calldata,
+            jnp.clip(src_idx, 0, state.calldata.shape[1] - 1).astype(I32),
+            axis=1)
+        cd = jnp.where(copy_src_fits[:, None]
+                       & (src_idx < state.calldata_len[:, None]), cd, 0)
+        co = jnp.take_along_axis(
+            state.code,
+            jnp.clip(src_idx, 0, state.code.shape[1] - 1).astype(I32), axis=1)
+        co = jnp.where(copy_src_fits[:, None]
+                       & (src_idx < state.code_len[:, None]), co, 0)
+        rd = jnp.take_along_axis(
+            state.retdata,
+            jnp.clip(src_idx, 0, state.retdata.shape[1] - 1).astype(I32),
+            axis=1)
+        rd = jnp.where(copy_src_fits[:, None]
+                       & (src_idx < state.retdata_len[:, None]), rd, 0)
+        mm = _mem_read(mem, state.msize, copy_src_off, COPY_MAX)
+        src = jnp.where(is_op("CALLDATACOPY")[:, None], cd,
+              jnp.where(is_op("CODECOPY")[:, None], co,
+              jnp.where(is_op("RETURNDATACOPY")[:, None], rd, mm)))
+        dst_off = jnp.where(is_op("MCOPY"), _word_to_i64(a)[0], off_i)
+        return _mem_write(mem, copy_do, dst_off, src,
+                          size=copy_len.astype(I32))
+
+    new_memory = jax.lax.cond(jnp.any(copy_do), _do_copy,
+                              lambda mem: mem, new_memory)
+
+    # ---- storage writes --------------------------------------------------------------
+    sstore_mask = running & is_op("SSTORE")
+    tstore_mask = running & is_op("TSTORE")
+
+    def _do_sstore(args):
+        keys, vals, used = args
+        return _table_set(keys, vals, used, sstore_mask, a, b)
+
+    storage_keys, storage_vals, storage_used, sstore_full = jax.lax.cond(
+        jnp.any(sstore_mask), _do_sstore,
+        lambda args: (args[0], args[1], args[2],
+                      jnp.zeros(batch, dtype=bool)),
+        (state.storage_keys, state.storage_vals, state.storage_used))
+
+    def _do_tstore(args):
+        keys, vals, used = args
+        return _table_set(keys, vals, used, tstore_mask, a, b)
+
+    tstore_keys, tstore_vals, tstore_used, tstore_full = jax.lax.cond(
+        jnp.any(tstore_mask), _do_tstore,
+        lambda args: (args[0], args[1], args[2],
+                      jnp.zeros(batch, dtype=bool)),
+        (state.tstore_keys, state.tstore_vals, state.tstore_used))
+
+    # ---- control flow ----------------------------------------------------------------
+    next_pc = state.pc + 1 + jnp.where(is_push, imm_len, 0)
+    jump_dest_i, jump_fits = _word_to_i64(a)
+    jump_dest = jnp.clip(jump_dest_i, 0, state.code.shape[1] - 1).astype(I32)
+    dest_ok = jump_fits & (jump_dest_i < state.code_len) & \
+        jnp.take_along_axis(state.jumpdest, jump_dest[:, None], axis=1)[:, 0]
+    take_jumpi = is_op("JUMPI") & ~words.is_zero(b)
+    jumping = is_op("JUMP") | take_jumpi
+    bad_jump = jumping & ~dest_ok
+    next_pc = jnp.where(jumping & dest_ok, jump_dest, next_pc)
+
+    # ---- halting ---------------------------------------------------------------------
+    ret_mask = running & op_in("RETURN", "REVERT") & ~mem_oog & ~mem_escape
+    ret_len = jnp.where(ret_mask, size_i, 0)
+    ret_cap = state.retdata.shape[1]
+    ret_escape = ret_mask & (ret_len > ret_cap)
+    ret_do = ret_mask & ~ret_escape
+
+    def _do_return(retdata):
+        payload = _mem_read(state.memory, new_msize, off_i, ret_cap)
+        write = ret_do[:, None] & (jnp.arange(ret_cap) < ret_len[:, None])
+        return jnp.where(write, payload, retdata)
+
+    new_retdata = jax.lax.cond(jnp.any(ret_do), _do_return,
+                               lambda rd: rd, state.retdata)
+    new_retdata_len = jnp.where(ret_do, ret_len.astype(I32),
+                                state.retdata_len)
+
+    # ---- status resolution (order matters: errors > escapes > halts) -----------------
+    new_status = jnp.full_like(state.status, RUNNING)
+    new_status = jnp.where(is_op("STOP") | (ret_do & is_op("RETURN")),
+                           jnp.where(is_op("STOP"), STOPPED, RETURNED),
+                           new_status)
+    new_status = jnp.where(ret_do & is_op("REVERT"), REVERTED, new_status)
+    wants_escape = (escape | overflow_cap | mem_escape | sha_escape
+                    | copy_escape | ret_escape | sstore_full | tstore_full)
+    new_status = jnp.where(wants_escape, ESCAPED, new_status)
+    is_error = (invalid | underflow | overflow_evm | oog | mem_oog | bad_jump
+                | is_op("INVALID"))
+    new_status = jnp.where(is_error, ERRORED, new_status)
+
+    advanced = ~is_error & ~wants_escape
+
+    def merge(new, old):
+        mask = running
+        while mask.ndim < new.ndim:
+            mask = mask[..., None]
+        return jnp.where(mask, new, old)
+
+    def merge_adv(new, old):
+        mask = running & advanced
+        while mask.ndim < new.ndim:
+            mask = mask[..., None]
+        return jnp.where(mask, new, old)
+
+    return StateBatch(
+        stack=merge_adv(new_stack, state.stack),
+        sp=merge_adv(new_sp, state.sp),
+        pc=merge_adv(next_pc, state.pc),
+        gas_used=merge_adv(new_gas_used, state.gas_used),
+        gas_limit=state.gas_limit,
+        status=merge(new_status, state.status),
+        memory=merge_adv(new_memory, state.memory),
+        msize=merge_adv(new_msize, state.msize),
+        code=state.code,
+        code_len=state.code_len,
+        jumpdest=state.jumpdest,
+        calldata=state.calldata,
+        calldata_len=state.calldata_len,
+        retdata=merge_adv(new_retdata, state.retdata),
+        retdata_len=merge_adv(new_retdata_len, state.retdata_len),
+        storage_keys=merge_adv(storage_keys, state.storage_keys),
+        storage_vals=merge_adv(storage_vals, state.storage_vals),
+        storage_used=merge_adv(storage_used, state.storage_used),
+        tstore_keys=merge_adv(tstore_keys, state.tstore_keys),
+        tstore_vals=merge_adv(tstore_vals, state.tstore_vals),
+        tstore_used=merge_adv(tstore_used, state.tstore_used),
+        address=state.address, caller=state.caller, origin=state.origin,
+        callvalue=state.callvalue, gasprice=state.gasprice,
+        coinbase=state.coinbase, timestamp=state.timestamp,
+        number=state.number, prevrandao=state.prevrandao,
+        block_gaslimit=state.block_gaslimit, chainid=state.chainid,
+        basefee=state.basefee, selfbalance=state.selfbalance,
+    )
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def step_many(state: StateBatch, n_steps: int) -> StateBatch:
+    """n_steps lockstep steps fused into one XLA computation."""
+    return jax.lax.fori_loop(0, n_steps, lambda _, s: step(s), state)
+
+
+def run(state: StateBatch, max_steps: int = 100_000,
+        chunk: int = 64) -> StateBatch:
+    """Host driver: step in fused chunks until every lane halted (or budget)."""
+    steps = 0
+    while steps < max_steps:
+        state = step_many(state, chunk)
+        steps += chunk
+        if not bool(jnp.any(state.status == RUNNING)):
+            break
+    return state

@@ -1,0 +1,393 @@
+"""256-bit EVM words as 16 little-endian 16-bit limbs held in uint32 lanes.
+
+Why 16-bit limbs: every partial product of two limbs fits a native uint32
+(65535^2 < 2^32), so multiplication, carries and comparisons all stay in the
+TPU's native 32-bit integer lanes — no emulated 64-bit arithmetic anywhere in
+the hot path. The last axis of every word tensor has size ``NLIMBS``; all ops
+broadcast over arbitrary leading batch axes.
+
+EVM semantics (not SMT-LIB): DIV/MOD/SDIV/SMOD by zero give 0, SDIV of
+INT_MIN by -1 wraps to INT_MIN (yellow paper appendix H). The host oracle
+(`core/instructions.py`) is the semantic referee; `tests/test_parallel_words.py`
+differentially checks every op against Python bignum arithmetic.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NLIMBS = 16
+LIMB_BITS = 16
+LIMB_MASK = (1 << LIMB_BITS) - 1
+WORD_BITS = NLIMBS * LIMB_BITS  # 256
+
+U32 = jnp.uint32
+
+
+# -- host converters -----------------------------------------------------------------
+
+def from_int(value: int, batch_shape=()) -> jnp.ndarray:
+    """Python int -> word tensor (broadcast to batch_shape + (NLIMBS,))."""
+    value &= (1 << WORD_BITS) - 1
+    limbs = np.array([(value >> (LIMB_BITS * i)) & LIMB_MASK
+                      for i in range(NLIMBS)], dtype=np.uint32)
+    return jnp.broadcast_to(jnp.asarray(limbs), tuple(batch_shape) + (NLIMBS,))
+
+def to_ints(words) -> np.ndarray:
+    """Word tensor -> object ndarray of Python ints (host-side, for tests/escapes)."""
+    arr = np.asarray(words, dtype=np.uint64)
+    flat = arr.reshape(-1, NLIMBS)
+    out = np.empty(flat.shape[0], dtype=object)
+    for row in range(flat.shape[0]):
+        value = 0
+        for i in range(NLIMBS):
+            value |= int(flat[row, i]) << (LIMB_BITS * i)
+        out[row] = value
+    return out.reshape(arr.shape[:-1])
+
+def zero(batch_shape=()) -> jnp.ndarray:
+    return jnp.zeros(tuple(batch_shape) + (NLIMBS,), dtype=U32)
+
+
+# -- carry plumbing ------------------------------------------------------------------
+
+def _carry_propagate(raw: jnp.ndarray) -> jnp.ndarray:
+    """Normalize limbs that may exceed LIMB_MASK (each < 2^32) into canonical form,
+    dropping the final carry (mod 2^256)."""
+    out = []
+    carry = jnp.zeros(raw.shape[:-1], dtype=U32)
+    for i in range(NLIMBS):
+        limb = raw[..., i] + carry
+        out.append(limb & LIMB_MASK)
+        carry = limb >> LIMB_BITS
+    return jnp.stack(out, axis=-1)
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _carry_propagate(a + b)
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _carry_propagate((a ^ LIMB_MASK) + (jnp.arange(NLIMBS) == 0).astype(U32))
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    # a + ~b + 1 in one carry pass (all addends < 2^17 per limb, safe in uint32)
+    one = (jnp.arange(NLIMBS) == 0).astype(U32)
+    return _carry_propagate(a + (b ^ LIMB_MASK) + one)
+
+
+# -- multiplication ------------------------------------------------------------------
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Low 256 bits of a*b. Schoolbook over 16-bit limbs; partial products are
+    split lo/hi so column accumulators stay far below 2^32."""
+    prods = a[..., :, None] * b[..., None, :]          # [.., i, j], each < 2^32
+    lo = prods & LIMB_MASK
+    hi = prods >> LIMB_BITS
+    cols = jnp.zeros(a.shape[:-1] + (NLIMBS,), dtype=U32)
+    for k in range(NLIMBS):
+        acc = jnp.zeros(a.shape[:-1], dtype=U32)
+        for i in range(k + 1):
+            acc = acc + lo[..., i, k - i]
+        for i in range(k):
+            acc = acc + hi[..., i, k - 1 - i]
+        cols = cols.at[..., k].set(acc)
+    # columns are < 33*2^16: two carry passes fully normalize
+    return _carry_propagate(_carry_propagate(cols))
+
+def mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Full 512-bit product as 32 limbs (for MULMOD)."""
+    prods = a[..., :, None] * b[..., None, :]
+    lo = prods & LIMB_MASK
+    hi = prods >> LIMB_BITS
+    ncols = 2 * NLIMBS
+    cols = jnp.zeros(a.shape[:-1] + (ncols,), dtype=U32)
+    for k in range(ncols):
+        acc = jnp.zeros(a.shape[:-1], dtype=U32)
+        for i in range(NLIMBS):
+            j = k - i
+            if 0 <= j < NLIMBS:
+                acc = acc + lo[..., i, j]
+            j = k - 1 - i
+            if 0 <= j < NLIMBS:
+                acc = acc + hi[..., i, j]
+        cols = cols.at[..., k].set(acc)
+    return _wide_carry(_wide_carry(cols))
+
+def _wide_carry(raw: jnp.ndarray) -> jnp.ndarray:
+    out = []
+    carry = jnp.zeros(raw.shape[:-1], dtype=U32)
+    for i in range(raw.shape[-1]):
+        limb = raw[..., i] + carry
+        out.append(limb & LIMB_MASK)
+        carry = limb >> LIMB_BITS
+    return jnp.stack(out, axis=-1)
+
+
+# -- comparisons ---------------------------------------------------------------------
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=-1)
+
+def lt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Unsigned a < b: scan limbs MSB-first."""
+    result = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
+    decided = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
+    for i in reversed(range(NLIMBS)):
+        result = jnp.where(~decided & (a[..., i] < b[..., i]), True, result)
+        decided = decided | (a[..., i] != b[..., i])
+    return result
+
+def gt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return lt(b, a)
+
+def sign_bit(a: jnp.ndarray) -> jnp.ndarray:
+    return (a[..., NLIMBS - 1] >> (LIMB_BITS - 1)) & 1
+
+def slt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    sa, sb = sign_bit(a), sign_bit(b)
+    # different signs: negative one is smaller; same sign: unsigned compare works
+    return jnp.where(sa != sb, sa == 1, lt(a, b))
+
+def sgt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return slt(b, a)
+
+def bool_to_word(flag: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where((jnp.arange(NLIMBS) == 0) & flag[..., None], U32(1), U32(0))
+
+
+# -- bitwise -------------------------------------------------------------------------
+
+def band(a, b):
+    return a & b
+
+def bor(a, b):
+    return a | b
+
+def bxor(a, b):
+    return a ^ b
+
+def bnot(a):
+    return a ^ LIMB_MASK
+
+
+# -- shifts --------------------------------------------------------------------------
+
+def _shift_amount(shift_word: jnp.ndarray) -> jnp.ndarray:
+    """Per-lane scalar shift amount clamped to [0, 256]."""
+    low = shift_word[..., 0].astype(jnp.int32)
+    oversized = jnp.any(shift_word[..., 1:] != 0, axis=-1) | (low > WORD_BITS)
+    return jnp.where(oversized, WORD_BITS, low)
+
+def shl(shift_word: jnp.ndarray, value: jnp.ndarray) -> jnp.ndarray:
+    amount = _shift_amount(shift_word)
+    limb_shift = amount // LIMB_BITS
+    bit_shift = (amount % LIMB_BITS).astype(U32)
+    idx = jnp.arange(NLIMBS)
+    src = idx - limb_shift[..., None]                   # limb that lands at idx
+    base = jnp.where(src >= 0,
+                     jnp.take_along_axis(value, jnp.clip(src, 0, NLIMBS - 1),
+                                         axis=-1), 0)
+    below = jnp.where(src - 1 >= 0,
+                      jnp.take_along_axis(value, jnp.clip(src - 1, 0, NLIMBS - 1),
+                                          axis=-1), 0)
+    bs = bit_shift[..., None]
+    out = jnp.where(bs == 0, base,
+                    ((base << bs) | (below >> (LIMB_BITS - bs))) & LIMB_MASK)
+    return jnp.where(amount[..., None] >= WORD_BITS, 0, out & LIMB_MASK)
+
+def shr(shift_word: jnp.ndarray, value: jnp.ndarray) -> jnp.ndarray:
+    amount = _shift_amount(shift_word)
+    limb_shift = amount // LIMB_BITS
+    bit_shift = (amount % LIMB_BITS).astype(U32)
+    idx = jnp.arange(NLIMBS)
+    src = idx + limb_shift[..., None]
+    base = jnp.where(src < NLIMBS,
+                     jnp.take_along_axis(value, jnp.clip(src, 0, NLIMBS - 1),
+                                         axis=-1), 0)
+    above = jnp.where(src + 1 < NLIMBS,
+                      jnp.take_along_axis(value, jnp.clip(src + 1, 0, NLIMBS - 1),
+                                          axis=-1), 0)
+    bs = bit_shift[..., None]
+    out = jnp.where(bs == 0, base,
+                    ((base >> bs) | (above << (LIMB_BITS - bs))) & LIMB_MASK)
+    return jnp.where(amount[..., None] >= WORD_BITS, 0, out)
+
+def sar(shift_word: jnp.ndarray, value: jnp.ndarray) -> jnp.ndarray:
+    amount = _shift_amount(shift_word)
+    negative = sign_bit(value) == 1
+    logical = shr(shift_word, value)
+    # fill the top `amount` bits with ones when negative
+    fill_mask = _high_bits_mask(amount)
+    filled = logical | fill_mask
+    out = jnp.where(negative[..., None], filled, logical)
+    all_ones = jnp.full(value.shape, LIMB_MASK, dtype=U32)
+    oversat = amount[..., None] >= WORD_BITS
+    return jnp.where(oversat, jnp.where(negative[..., None], all_ones, 0), out)
+
+def _high_bits_mask(amount: jnp.ndarray) -> jnp.ndarray:
+    """Word whose top `amount` bits are 1 (amount in [0,256])."""
+    start_bit = WORD_BITS - amount                       # first set bit index
+    limb_base = jnp.arange(NLIMBS) * LIMB_BITS
+    rel = jnp.clip(start_bit[..., None] - limb_base, 0, LIMB_BITS)
+    # limb i has its bits >= rel set
+    return (LIMB_MASK >> rel.astype(U32) << rel.astype(U32)) & LIMB_MASK
+
+
+# -- byte / signextend ---------------------------------------------------------------
+
+def byte_op(index_word: jnp.ndarray, value: jnp.ndarray) -> jnp.ndarray:
+    """EVM BYTE: big-endian byte `index` of value (0 = most significant)."""
+    index = index_word[..., 0].astype(jnp.int32)
+    oversized = jnp.any(index_word[..., 1:] != 0, axis=-1) | (index >= 32)
+    byte_from_lsb = 31 - jnp.clip(index, 0, 31)
+    limb = byte_from_lsb // 2
+    hi_byte = (byte_from_lsb % 2) == 1
+    limb_val = jnp.take_along_axis(value, limb[..., None], axis=-1)[..., 0]
+    byte_val = jnp.where(hi_byte, limb_val >> 8, limb_val & 0xFF)
+    result = jnp.where(oversized, 0, byte_val)
+    return jnp.where((jnp.arange(NLIMBS) == 0), result[..., None], U32(0))
+
+def signextend(size_word: jnp.ndarray, value: jnp.ndarray) -> jnp.ndarray:
+    """EVM SIGNEXTEND: sign-extend from byte position `size` (0 = LSB)."""
+    size = size_word[..., 0].astype(jnp.int32)
+    oversized = jnp.any(size_word[..., 1:] != 0, axis=-1) | (size >= 31)
+    sign_bit_index = size * 8 + 7
+    limb = jnp.clip(sign_bit_index // LIMB_BITS, 0, NLIMBS - 1)
+    bit = (sign_bit_index % LIMB_BITS).astype(U32)
+    limb_val = jnp.take_along_axis(value, limb[..., None], axis=-1)[..., 0]
+    is_negative = ((limb_val >> bit) & 1) == 1
+    ext_mask = _high_bits_mask(WORD_BITS - 1 - sign_bit_index)
+    extended = jnp.where(is_negative[..., None], value | ext_mask,
+                         value & bnot(ext_mask))
+    return jnp.where(oversized[..., None], value, extended)
+
+
+# -- division ------------------------------------------------------------------------
+
+def _divmod_bits(a: jnp.ndarray, b: jnp.ndarray, n_bits: int):
+    """Binary restoring division of an n_bits-wide dividend `a` (with as many limbs
+    as needed) by a 256-bit divisor. Returns (quotient mod 2^256, remainder)."""
+    n_limbs = a.shape[-1]
+
+    def body(i, carry):
+        quotient, rem = carry
+        bit_index = n_bits - 1 - i
+        limb = bit_index // LIMB_BITS
+        bit = (bit_index % LIMB_BITS)
+        next_bit = (a[..., limb] >> U32(bit)) & 1
+        # rem = (rem << 1) | next_bit     (rem stays < 2*b <= 2^257: 17 limbs)
+        rem = _shl1_17(rem, next_bit)
+        ge = ~lt_wide(rem, b)
+        rem = jnp.where(ge[..., None], sub_wide(rem, b), rem)
+        q_limb = bit_index // LIMB_BITS
+        q_set = jnp.where((jnp.arange(NLIMBS) == q_limb) & ge[..., None]
+                          & (q_limb < NLIMBS),
+                          U32(1) << U32(bit), U32(0))
+        quotient = quotient | q_set
+        return quotient, rem
+
+    quotient = zero(a.shape[:-1])
+    rem = jnp.zeros(a.shape[:-1] + (NLIMBS + 1,), dtype=U32)
+    quotient, rem = jax.lax.fori_loop(0, n_bits, body, (quotient, rem))
+    return quotient, rem[..., :NLIMBS]
+
+def _shl1_17(rem: jnp.ndarray, in_bit: jnp.ndarray) -> jnp.ndarray:
+    carry_out = rem >> (LIMB_BITS - 1)
+    shifted = ((rem << 1) & LIMB_MASK)
+    shifted = shifted.at[..., 0].add(in_bit)
+    shifted = shifted.at[..., 1:].add(carry_out[..., :-1])
+    return shifted
+
+def lt_wide(a17: jnp.ndarray, b16: jnp.ndarray) -> jnp.ndarray:
+    """a (17 limbs) < b (16 limbs)."""
+    b17 = jnp.concatenate([b16, jnp.zeros(b16.shape[:-1] + (1,), dtype=U32)], axis=-1)
+    result = jnp.zeros(a17.shape[:-1], dtype=jnp.bool_)
+    decided = jnp.zeros(a17.shape[:-1], dtype=jnp.bool_)
+    for i in reversed(range(NLIMBS + 1)):
+        result = jnp.where(~decided & (a17[..., i] < b17[..., i]), True, result)
+        decided = decided | (a17[..., i] != b17[..., i])
+    return result
+
+def sub_wide(a17: jnp.ndarray, b16: jnp.ndarray) -> jnp.ndarray:
+    b17 = jnp.concatenate([b16, jnp.zeros(b16.shape[:-1] + (1,), dtype=U32)], axis=-1)
+    one = (jnp.arange(NLIMBS + 1) == 0).astype(U32)
+    raw = a17 + (b17 ^ LIMB_MASK) + one
+    out = []
+    carry = jnp.zeros(raw.shape[:-1], dtype=U32)
+    for i in range(NLIMBS + 1):
+        limb = raw[..., i] + carry
+        out.append(limb & LIMB_MASK)
+        carry = limb >> LIMB_BITS
+    return jnp.stack(out, axis=-1)
+
+def divmod_(a: jnp.ndarray, b: jnp.ndarray):
+    """EVM DIV/MOD: (a // b, a % b), both 0 when b == 0."""
+    q, r = _divmod_bits(a, b, WORD_BITS)
+    bz = is_zero(b)[..., None]
+    return jnp.where(bz, 0, q), jnp.where(bz, 0, r)
+
+def sdiv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    sa, sb = sign_bit(a) == 1, sign_bit(b) == 1
+    abs_a = jnp.where(sa[..., None], neg(a), a)
+    abs_b = jnp.where(sb[..., None], neg(b), b)
+    q, _ = _divmod_bits(abs_a, abs_b, WORD_BITS)
+    q = jnp.where((sa ^ sb)[..., None], neg(q), q)
+    return jnp.where(is_zero(b)[..., None], 0, q)
+
+def smod(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    sa, sb = sign_bit(a) == 1, sign_bit(b) == 1
+    abs_a = jnp.where(sa[..., None], neg(a), a)
+    abs_b = jnp.where(sb[..., None], neg(b), b)
+    _, r = _divmod_bits(abs_a, abs_b, WORD_BITS)
+    r = jnp.where(sa[..., None], neg(r), r)
+    return jnp.where(is_zero(b)[..., None], 0, r)
+
+def addmod(a: jnp.ndarray, b: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    """(a + b) % n over the true 257-bit sum."""
+    raw = a + b
+    wide = jnp.concatenate([raw, jnp.zeros(raw.shape[:-1] + (1,), dtype=U32)],
+                           axis=-1)
+    wide = _wide_carry(wide)
+    _, r = _divmod_bits(wide, n, WORD_BITS + 1)
+    return jnp.where(is_zero(n)[..., None], 0, r)
+
+def mulmod(a: jnp.ndarray, b: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    """(a * b) % n over the true 512-bit product."""
+    wide = mul_wide(a, b)
+    _, r = _divmod_bits(wide, n, 2 * WORD_BITS)
+    return jnp.where(is_zero(n)[..., None], 0, r)
+
+def exp(base: jnp.ndarray, exponent: jnp.ndarray) -> jnp.ndarray:
+    """base ** exponent mod 2^256 by square-and-multiply over all 256 bits."""
+    def body(i, carry):
+        acc, pw = carry
+        limb = i // LIMB_BITS
+        bit = i % LIMB_BITS
+        take = ((exponent[..., limb] >> U32(bit)) & 1) == 1
+        acc = jnp.where(take[..., None], mul(acc, pw), acc)
+        return acc, mul(pw, pw)
+
+    acc = from_int(1, base.shape[:-1])
+    acc, _ = jax.lax.fori_loop(0, WORD_BITS, body, (acc, base))
+    return acc
+
+
+# -- byte packing --------------------------------------------------------------------
+
+def to_bytes(words: jnp.ndarray) -> jnp.ndarray:
+    """Word tensor [..., NLIMBS] -> big-endian bytes [..., 32] (uint8)."""
+    hi = (words >> 8).astype(jnp.uint8)
+    lo = (words & 0xFF).astype(jnp.uint8)
+    interleaved = jnp.stack([lo, hi], axis=-1).reshape(words.shape[:-1] + (32,))
+    return interleaved[..., ::-1]
+
+def from_bytes(data: jnp.ndarray) -> jnp.ndarray:
+    """Big-endian bytes [..., 32] -> word tensor [..., NLIMBS]."""
+    le = data[..., ::-1].astype(U32)
+    lo = le[..., 0::2]
+    hi = le[..., 1::2]
+    return lo | (hi << 8)

@@ -29,6 +29,22 @@ from .solver_statistics import SolverStatistics, stat_smt_query
 CONFLICTS_PER_MS = 160
 
 
+def _solve_backend(clauses, n_vars, max_conflicts):
+    """Route to the configured SAT backend: the batched JAX solver
+    (`--solver jax`, parallel/jax_solver.py) with CDCL fallback on unknown, or
+    the native CDCL core directly."""
+    from ...support.support_args import args
+
+    if args.solver == "jax":
+        from ...parallel import jax_solver
+
+        status, model = jax_solver.solve_cnf_device(
+            clauses, n_vars, max_steps=min(max_conflicts, 50_000))
+        if status != jax_solver.UNKNOWN:
+            return status, model
+    return sat.solve_cnf(clauses, n_vars, max_conflicts)
+
+
 def check_formulas(raw_constraints: List[terms.Term],
                    max_conflicts: int = 2_000_000) -> Tuple[str, Optional[Model]]:
     """The core decision procedure. Returns ("sat"|"unsat"|"unknown", model)."""
@@ -47,7 +63,8 @@ def check_formulas(raw_constraints: List[terms.Term],
     blaster = Blaster()
     for constraint in lowered:
         blaster.assert_true(constraint)
-    status, sat_model = sat.solve_cnf(blaster.clauses, blaster.n_vars, max_conflicts)
+    status, sat_model = _solve_backend(blaster.clauses, blaster.n_vars,
+                                       max_conflicts)
     if status == sat.UNSAT:
         return "unsat", None
     if status == sat.UNKNOWN:

@@ -7,10 +7,17 @@ very top so any transitive jax import sees them.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Force CPU with 8 virtual devices even when the shell environment selects a
+# TPU platform (JAX_PLATFORMS=axon): CI correctness tests must not contend for
+# the real chip — bench.py owns it. The TPU plugin registers at interpreter
+# startup (sitecustomize), so env vars are too late, but the jax *config*
+# overrides still win as long as no computation has run yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 

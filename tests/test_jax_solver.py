@@ -1,0 +1,98 @@
+"""Differential tests: batched JAX DPLL (parallel/jax_solver.py) vs the native
+CDCL core / Python DPLL on the same CNF, plus end-to-end `--solver jax` runs
+through the full QF_ABV pipeline (lower -> blast -> solve)."""
+
+import random
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from mythril_tpu.parallel import jax_solver  # noqa: E402
+from mythril_tpu.smt import symbol_factory  # noqa: E402
+from mythril_tpu.smt.solver import sat  # noqa: E402
+from mythril_tpu.smt.solver.solver import Solver, check_formulas  # noqa: E402
+from mythril_tpu.support.support_args import args  # noqa: E402
+
+
+def _check_model(clauses, model):
+    for clause in clauses:
+        assert any((model[abs(l) - 1] if l > 0 else not model[abs(l) - 1])
+                   for l in clause), f"clause {clause} unsatisfied"
+
+
+def _random_cnf(rng, n_vars, n_clauses, k=3):
+    return [[rng.choice([-1, 1]) * rng.randint(1, n_vars)
+             for _ in range(rng.randint(1, k))]
+            for _ in range(n_clauses)]
+
+
+def test_trivial():
+    status, model = jax_solver.solve_cnf_device([[1], [2, -1]], 2)
+    assert status == jax_solver.SAT
+    _check_model([[1], [2, -1]], model)
+
+    status, _ = jax_solver.solve_cnf_device([[1], [-1]], 1)
+    assert status == jax_solver.UNSAT
+
+
+def test_random_cnf_differential():
+    rng = random.Random(7)
+    agree = 0
+    for trial in range(30):
+        n_vars = rng.randint(3, 24)
+        # around the sat/unsat phase transition so both verdicts appear
+        n_clauses = int(n_vars * rng.uniform(2.0, 6.0))
+        clauses = _random_cnf(rng, n_vars, n_clauses)
+        ref_status, _ = sat.solve_cnf(clauses, n_vars)
+        dev_status, dev_model = jax_solver.solve_cnf_device(
+            clauses, n_vars, n_probes=8, max_steps=50_000)
+        assert dev_status != jax_solver.UNKNOWN, f"trial {trial} unknown"
+        assert dev_status == ref_status, f"trial {trial} verdict mismatch"
+        if dev_status == jax_solver.SAT:
+            _check_model(clauses, dev_model)
+        agree += 1
+    assert agree == 30
+
+
+def test_long_clauses_split():
+    # one long clause + forcing units; exercises the connector-splitting path
+    clauses = [[-1], [-2], [-3], [-4], [1, 2, 3, 4, 5]]
+    status, model = jax_solver.solve_cnf_device(clauses, 5)
+    assert status == jax_solver.SAT
+    assert model[4] is True
+
+    clauses = [[-1], [-2], [-3], [-4], [-5], [1, 2, 3, 4, 5]]
+    status, _ = jax_solver.solve_cnf_device(clauses, 5)
+    assert status == jax_solver.UNSAT
+
+
+def test_pipeline_with_jax_backend():
+    """Full QF_BV queries through Solver with --solver jax."""
+    a = symbol_factory.BitVecSym("a", 32)
+    b = symbol_factory.BitVecSym("b", 32)
+    cases_sat = [
+        [a + b == 100, a > 10, b > 10],
+        [a * symbol_factory.BitVecVal(3, 32) == 99],
+        [(a & 0xFF) == 0x42, a > 1000],
+    ]
+    cases_unsat = [
+        [a > b, b > a],
+        [a == 5, a == 6],
+        [a + 1 < a, a == 0],
+    ]
+    args.solver = "jax"
+    try:
+        for constraints in cases_sat:
+            solver = Solver(timeout=20_000)
+            solver.add(*constraints)
+            assert solver.check() == "sat"
+            model = solver.model()
+            for c in constraints:
+                assert model.eval(c.raw)
+        for constraints in cases_unsat:
+            solver = Solver(timeout=20_000)
+            solver.add(*constraints)
+            assert solver.check() == "unsat"
+    finally:
+        args.solver = "cdcl"

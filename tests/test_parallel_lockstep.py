@@ -1,0 +1,165 @@
+"""VMTests conformance for the TPU lockstep engine (SURVEY §4 tier: run the
+EVM conformance corpus through the batched interpreter, batch-of-many).
+
+Every supported VMTest becomes one lane of a single StateBatch; the whole
+corpus executes as a few lockstep `run` calls. Lanes that ESCAPE (CALL family,
+capacity overruns) fall back to the host oracle by design and are skipped
+here — the oracle's own conformance is covered by tests/test_vmtests.py.
+Storage expectations come from the JSON ground truth, the same source the
+oracle harness asserts against, which makes this a differential test between
+the two engines."""
+
+import json
+import os
+from glob import glob
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from mythril_tpu.parallel import batch as pbatch  # noqa: E402
+from mythril_tpu.parallel import lockstep  # noqa: E402
+
+VMTESTS_ROOT = os.environ.get(
+    "MYTHRIL_TPU_VMTESTS",
+    "/root/reference/tests/laser/evm_testsuite/VMTests")
+
+CATEGORIES = [
+    "vmArithmeticTest", "vmBitwiseLogicOperation", "vmEnvironmentalInfo",
+    "vmIOandFlowOperations", "vmPushDupSwapTest", "vmSha3Test", "vmTests",
+    "vmRandomTest",
+]
+
+# same scope cuts as the oracle harness (tests/test_vmtests.py), minus areas the
+# lockstep engine escapes on anyway
+from test_vmtests import SKIP_NAMES  # noqa: E402
+
+
+def _hex(value: str) -> int:
+    return int(value, 16) if value else 0
+
+
+def _bytes(value: str) -> bytes:
+    value = value[2:] if value.startswith("0x") else value
+    return bytes.fromhex(value)
+
+
+def _collect():
+    cases = []
+    if not os.path.isdir(VMTESTS_ROOT):
+        return cases
+    for category in CATEGORIES:
+        for path in sorted(glob(os.path.join(VMTESTS_ROOT, category, "*.json"))):
+            name = os.path.splitext(os.path.basename(path))[0]
+            if name in SKIP_NAMES:
+                continue
+            with open(path) as fh:
+                data = json.load(fh)
+            if name not in data:
+                continue
+            cases.append((f"{category}/{name}", data[name]))
+    return cases
+
+
+CASES = _collect()
+
+
+def _spec_for(test) -> pbatch.LaneSpec:
+    execution = test["exec"]
+    env = test["env"]
+    address = _hex(execution["address"])
+    pre = test.get("pre", {})
+    storage = {}
+    balance = 0
+    for acct_hex, details in pre.items():
+        if _hex(acct_hex) == address:
+            storage = {_hex(k): _hex(v)
+                       for k, v in details.get("storage", {}).items()}
+            balance = _hex(details.get("balance", "0x0"))
+    return pbatch.LaneSpec(
+        code=_bytes(execution["code"]),
+        calldata=_bytes(execution.get("data", "")),
+        storage=storage,
+        gas_limit=min(_hex(execution["gas"]), 2 ** 62),
+        address=address,
+        caller=_hex(execution["caller"]),
+        origin=_hex(execution["origin"]),
+        callvalue=_hex(execution["value"]),
+        gasprice=_hex(execution["gasPrice"]),
+        coinbase=_hex(env.get("currentCoinbase", "0x0")),
+        timestamp=_hex(env.get("currentTimestamp", "0x0")),
+        number=_hex(env.get("currentNumber", "0x0")),
+        prevrandao=_hex(env.get("currentDifficulty", "0x0")),
+        block_gaslimit=_hex(env.get("currentGasLimit", "0x0")),
+        selfbalance=balance,
+    )
+
+
+@pytest.fixture(scope="module")
+def corpus_result():
+    if not CASES:
+        pytest.skip("VMTests corpus not present")
+    specs = []
+    usable = []
+    for name, test in CASES:
+        try:
+            spec = _spec_for(test)
+        except ValueError:
+            continue  # e.g. >64 initial storage slots
+        if len(spec.code) == 0:
+            continue
+        specs.append(spec)
+        usable.append((name, test))
+    state = pbatch.build_batch(specs, calldata_bytes=512)
+    state = lockstep.run(state, max_steps=4096, chunk=64)
+    return usable, state
+
+
+def test_corpus_coverage(corpus_result):
+    """The lockstep engine must genuinely execute most of the corpus on device
+    (escaping everything would vacuously pass the storage checks)."""
+    usable, state = corpus_result
+    status = np.asarray(state.status)
+    on_device = int(np.sum(status != pbatch.ESCAPED))
+    assert len(usable) > 300, f"corpus unexpectedly small: {len(usable)}"
+    assert on_device / len(usable) > 0.75, \
+        f"only {on_device}/{len(usable)} lanes finished on device"
+    assert int(np.sum(status == pbatch.RUNNING)) == 0, "lanes still running"
+
+
+def test_corpus_storage_conformance(corpus_result):
+    usable, state = corpus_result
+    status = np.asarray(state.status)
+    failures = []
+    checked = 0
+    for lane, (name, test) in enumerate(usable):
+        if status[lane] == pbatch.ESCAPED:
+            continue
+        address = _hex(test["exec"]["address"])
+        if "post" not in test:
+            # must abort: success statuses are conformance failures
+            if status[lane] in (pbatch.STOPPED, pbatch.RETURNED):
+                failures.append(f"{name}: expected abort, got "
+                                f"{pbatch.STATUS_NAMES[status[lane]]}")
+            checked += 1
+            continue
+        if status[lane] not in (pbatch.STOPPED, pbatch.RETURNED):
+            failures.append(f"{name}: expected success, got "
+                            f"{pbatch.STATUS_NAMES[status[lane]]}")
+            continue
+        got = pbatch.extract_storage(state, lane)
+        for acct_hex, details in test["post"].items():
+            if _hex(acct_hex) != address:
+                continue
+            for slot_hex, value_hex in details.get("storage", {}).items():
+                slot, expected = _hex(slot_hex), _hex(value_hex)
+                actual = got.get(slot, 0)
+                if actual != expected:
+                    failures.append(
+                        f"{name}: storage[{hex(slot)}] = {hex(actual)}, "
+                        f"expected {hex(expected)}")
+        checked += 1
+    assert checked > 250, f"too few lanes checked on device: {checked}"
+    assert not failures, \
+        f"{len(failures)} conformance failures:\n" + "\n".join(failures[:25])

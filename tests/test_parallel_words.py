@@ -1,0 +1,212 @@
+"""Differential tests: parallel.words limb arithmetic vs Python bignums.
+
+Every op is exercised on a batch of adversarial + random 256-bit values; the
+expected result is computed with exact Python integer arithmetic implementing
+yellow-paper semantics (DIV/MOD by zero = 0 etc.)."""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from mythril_tpu.parallel import words  # noqa: E402
+
+M = 1 << 256
+MASK = M - 1
+
+INTERESTING = [
+    0, 1, 2, 3, MASK, MASK - 1, 1 << 255, (1 << 255) - 1, 1 << 128,
+    (1 << 128) - 1, 0xFF, 0x100, 0xFFFF, 0x10000, 255, 256, 257,
+]
+
+random.seed(1234)
+RANDOMS = [random.getrandbits(256) for _ in range(40)] + \
+          [random.getrandbits(8) for _ in range(10)] + \
+          [random.getrandbits(64) for _ in range(10)]
+
+PAIRS = [(a, b) for a in INTERESTING for b in INTERESTING] + \
+        list(zip(RANDOMS, reversed(RANDOMS)))
+
+
+def _signed(x):
+    return x - M if x >> 255 else x
+
+
+def _batch(pairs):
+    a = words.from_int(0, (len(pairs),)).copy()
+    av = np.stack([np.asarray(words.from_int(p[0])) for p in pairs])
+    bv = np.stack([np.asarray(words.from_int(p[1])) for p in pairs])
+    return words.U32(av), words.U32(bv)
+
+
+A, B = _batch(PAIRS)
+A_INTS = [p[0] for p in PAIRS]
+B_INTS = [p[1] for p in PAIRS]
+
+
+def check(op_name, got_words, expected_fn):
+    got = words.to_ints(got_words)
+    for i, (x, y) in enumerate(zip(A_INTS, B_INTS)):
+        expected = expected_fn(x, y) & MASK
+        assert got[i] == expected, \
+            f"{op_name}({hex(x)}, {hex(y)}): got {hex(got[i])}, " \
+            f"expected {hex(expected)}"
+
+
+def test_add():
+    check("add", words.add(A, B), lambda x, y: x + y)
+
+
+def test_sub():
+    check("sub", words.sub(A, B), lambda x, y: x - y)
+
+
+def test_mul():
+    check("mul", words.mul(A, B), lambda x, y: x * y)
+
+
+def test_div():
+    q, r = words.divmod_(A, B)
+    check("div", q, lambda x, y: x // y if y else 0)
+    check("mod", r, lambda x, y: x % y if y else 0)
+
+
+def test_sdiv():
+    def expected(x, y):
+        sx, sy = _signed(x), _signed(y)
+        if sy == 0:
+            return 0
+        return abs(sx) // abs(sy) * (-1 if (sx < 0) != (sy < 0) else 1)
+    check("sdiv", words.sdiv(A, B), expected)
+
+
+def test_smod():
+    def expected(x, y):
+        sx, sy = _signed(x), _signed(y)
+        if sy == 0:
+            return 0
+        return abs(sx) % abs(sy) * (-1 if sx < 0 else 1)
+    check("smod", words.smod(A, B), expected)
+
+
+def test_addmod():
+    n = words.from_int(0xFFFF_FFFF_FFF1, (A.shape[0],))
+    got = words.to_ints(words.addmod(A, B, n))
+    for i, (x, y) in enumerate(zip(A_INTS, B_INTS)):
+        assert got[i] == (x + y) % 0xFFFF_FFFF_FFF1
+
+
+def test_addmod_zero_and_full():
+    # n = 0 and n near 2^256
+    pairs = PAIRS[:20]
+    a, b = A[:20], B[:20]
+    for n_int in (0, MASK, 3):
+        n = words.from_int(n_int, (20,))
+        got = words.to_ints(words.addmod(a, b, n))
+        for i in range(20):
+            expected = (A_INTS[i] + B_INTS[i]) % n_int if n_int else 0
+            assert got[i] == expected
+
+
+def test_mulmod():
+    for n_int in (0xFFFF_FFFF_FFF1, MASK, 0, 7):
+        n = words.from_int(n_int, (30,))
+        got = words.to_ints(words.mulmod(A[:30], B[:30], n))
+        for i in range(30):
+            expected = (A_INTS[i] * B_INTS[i]) % n_int if n_int else 0
+            assert got[i] == expected
+
+
+def test_exp():
+    pairs = [(3, 7), (2, 256), (0, 0), (5, 0), (0, 5), (MASK, 3),
+             (1 << 128, 2), (7, 1 << 130), (10, 77)]
+    a = words.U32(np.stack([np.asarray(words.from_int(p[0])) for p in pairs]))
+    b = words.U32(np.stack([np.asarray(words.from_int(p[1])) for p in pairs]))
+    got = words.to_ints(words.exp(a, b))
+    for i, (x, y) in enumerate(pairs):
+        assert got[i] == pow(x, y, M)
+
+
+def test_comparisons():
+    lt = np.asarray(words.lt(A, B))
+    gt = np.asarray(words.gt(A, B))
+    eq = np.asarray(words.eq(A, B))
+    slt = np.asarray(words.slt(A, B))
+    sgt = np.asarray(words.sgt(A, B))
+    for i, (x, y) in enumerate(zip(A_INTS, B_INTS)):
+        assert lt[i] == (x < y)
+        assert gt[i] == (x > y)
+        assert eq[i] == (x == y)
+        assert slt[i] == (_signed(x) < _signed(y))
+        assert sgt[i] == (_signed(x) > _signed(y))
+
+
+def test_bitwise():
+    check("and", words.band(A, B), lambda x, y: x & y)
+    check("or", words.bor(A, B), lambda x, y: x | y)
+    check("xor", words.bxor(A, B), lambda x, y: x ^ y)
+    check("not", words.bnot(A), lambda x, y: ~x)
+
+
+def test_shifts():
+    shifts = [0, 1, 7, 8, 15, 16, 17, 100, 255, 256, 300, MASK]
+    vals = [1, MASK, 1 << 255, 0xDEADBEEF, RANDOMS[0], RANDOMS[1]]
+    pairs = [(s, v) for s in shifts for v in vals]
+    s = words.U32(np.stack([np.asarray(words.from_int(p[0])) for p in pairs]))
+    v = words.U32(np.stack([np.asarray(words.from_int(p[1])) for p in pairs]))
+    shl = words.to_ints(words.shl(s, v))
+    shr = words.to_ints(words.shr(s, v))
+    sar = words.to_ints(words.sar(s, v))
+    for i, (sh, val) in enumerate(pairs):
+        expected_shl = (val << sh) & MASK if sh < 256 else 0
+        expected_shr = val >> sh if sh < 256 else 0
+        sv = _signed(val)
+        expected_sar = (sv >> min(sh, 255)) & MASK if sh < 256 else \
+            (MASK if sv < 0 else 0)
+        assert shl[i] == expected_shl, f"shl({sh}, {hex(val)})"
+        assert shr[i] == expected_shr, f"shr({sh}, {hex(val)})"
+        assert sar[i] == expected_sar, f"sar({sh}, {hex(val)})"
+
+
+def test_byte():
+    pairs = [(i, RANDOMS[0]) for i in range(34)] + [(MASK, RANDOMS[0])]
+    idx = words.U32(np.stack([np.asarray(words.from_int(p[0])) for p in pairs]))
+    val = words.U32(np.stack([np.asarray(words.from_int(p[1])) for p in pairs]))
+    got = words.to_ints(words.byte_op(idx, val))
+    raw = RANDOMS[0].to_bytes(32, "big")
+    for i, (position, _) in enumerate(pairs):
+        expected = raw[position] if position < 32 else 0
+        assert got[i] == expected
+
+
+def test_signextend():
+    pairs = [(k, v) for k in list(range(33)) + [MASK]
+             for v in (0x80, 0x7F, 0xFF80, RANDOMS[2], MASK)]
+    k = words.U32(np.stack([np.asarray(words.from_int(p[0])) for p in pairs]))
+    v = words.U32(np.stack([np.asarray(words.from_int(p[1])) for p in pairs]))
+    got = words.to_ints(words.signextend(k, v))
+    for i, (size, val) in enumerate(pairs):
+        if size >= 31:
+            expected = val
+        else:
+            bit = size * 8 + 7
+            if (val >> bit) & 1:
+                expected = (val | (MASK ^ ((1 << (bit + 1)) - 1))) & MASK
+            else:
+                expected = val & ((1 << (bit + 1)) - 1)
+        assert got[i] == expected, f"signextend({size}, {hex(val)})"
+
+
+def test_byte_roundtrip():
+    data = words.to_bytes(A)
+    back = words.from_bytes(data)
+    assert np.array_equal(np.asarray(back), np.asarray(A))
+    raw = np.asarray(data)
+    for i, x in enumerate(A_INTS):
+        assert bytes(raw[i].tolist()) == x.to_bytes(32, "big")
+
+
+def test_neg():
+    check("neg", words.neg(A), lambda x, y: -x)
