@@ -1,12 +1,15 @@
 """IssueAnnotation (API parity: mythril/analysis/issue_annotation.py:9): ties an
-Issue to the conditions under which it fired (used by symbolic summaries)."""
+Issue to the conditions under which it fired. Every detector attaches one per
+issue (reference modules do the same); with `--enable-summaries`
+(args.use_issue_annotations) the annotations replace direct issue emission and
+are re-validated when a summary is recorded or replayed."""
 
 from __future__ import annotations
 
 from typing import List
 
 from ..core.state.annotation import StateAnnotation
-from ..smt import Bool
+from ..smt import And, Bool
 
 
 class IssueAnnotation(StateAnnotation):
@@ -21,3 +24,11 @@ class IssueAnnotation(StateAnnotation):
 
     def __copy__(self):
         return IssueAnnotation(list(self.conditions), self.issue, self.detector)
+
+
+def attach_issue_annotation(state, issue, detector, constraints) -> None:
+    """Annotate the state with the proven condition set for `issue`
+    (reference modules attach IssueAnnotation(conditions=[And(*constraints)])
+    at every emission site, e.g. suicide.py:114)."""
+    state.annotate(IssueAnnotation(
+        conditions=[And(*constraints)], issue=issue, detector=detector))

@@ -39,6 +39,9 @@ class DetectionModule:
 
     def reset_module(self) -> None:
         self.issues = []
+        # the (address, code_hash) cache must not outlive one analysis: a
+        # fresh analysis of the same bytecode would silently report nothing
+        self.cache = set()
 
     def update_cache(self, issues: Optional[List[Issue]] = None) -> None:
         issues = issues if issues is not None else self.issues
@@ -52,8 +55,12 @@ class DetectionModule:
                 return []
         result = self._execute(target)
         if result:
-            self.issues.extend(result)
-            self.update_cache(result)
+            # in issue-annotation mode (--enable-summaries) issues are deferred:
+            # the summary plugin re-validates the attached IssueAnnotations
+            # against substituted conditions (reference module/base.py:93)
+            if not args.use_issue_annotations:
+                self.issues.extend(result)
+                self.update_cache(result)
         return result
 
     def _cache_hit(self, state: GlobalState) -> bool:

@@ -8,6 +8,8 @@ import logging
 
 from ...core.state.global_state import GlobalState
 from ...exceptions import UnsatError
+from ...support.model import get_model
+from ..issue_annotation import attach_issue_annotation
 from ..module.base import DetectionModule, EntryPoint
 from ..report import Issue
 from ..solver import get_transaction_sequence
@@ -27,12 +29,16 @@ class ArbitraryJump(DetectionModule):
         jump_dest = state.mstate.stack[-1]
         if jump_dest.raw.is_const:
             return []
+        if self._is_unique_jumpdest(jump_dest, state):
+            # symbolic but pinned to one feasible value: not attacker-steerable
+            # (reference arbitrary_jump.py:22-44)
+            return []
+        constraints = state.world_state.constraints.get_all_constraints()
         try:
-            transaction_sequence = get_transaction_sequence(
-                state, state.world_state.constraints.get_all_constraints())
+            transaction_sequence = get_transaction_sequence(state, constraints)
         except UnsatError:
             return []
-        return [Issue(
+        issue = Issue(
             contract=state.environment.active_account.contract_name,
             function_name=getattr(state.environment, "active_function_name",
                                   "fallback"),
@@ -51,4 +57,22 @@ class ArbitraryJump(DetectionModule):
                 "assembly to prevent this issue."),
             gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
             transaction_sequence=transaction_sequence,
-        )]
+        )
+        attach_issue_annotation(state, issue, self, constraints)
+        return [issue]
+
+    @staticmethod
+    def _is_unique_jumpdest(jump_dest, state: GlobalState) -> bool:
+        """True when the symbolic destination admits exactly one model."""
+        try:
+            model = get_model(tuple(
+                state.world_state.constraints.get_all_constraints()))
+            concrete_dest = model.eval(jump_dest.raw)
+            get_model(tuple(
+                state.world_state.constraints.get_all_constraints()
+                + [jump_dest != concrete_dest]))
+        except UnsatError:
+            return True  # no second value exists
+        except Exception:
+            return True  # solver timeout: do not report on uncertainty
+        return False

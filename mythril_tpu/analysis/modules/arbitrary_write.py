@@ -26,9 +26,10 @@ class ArbitraryStorage(DetectionModule):
         write_slot = state.mstate.stack[-1]
         if write_slot.raw.is_const:
             return []
-        # attacker-chosen probe slot: if the symbolic key can equal an arbitrary
-        # fresh value, the write is unconstrained
-        probe = symbol_factory.BitVecSym(f"probe_slot_{id(self)}", 256)
+        # a CONCRETE improbable probe slot (reference arbitrary_write.py:56):
+        # a fresh symbolic probe would be trivially satisfiable for any
+        # symbolic key and would report every symbolic write
+        probe = symbol_factory.BitVecVal(324345425435, 256)
         potential_issue = PotentialIssue(
             contract=state.environment.active_account.contract_name,
             function_name=getattr(state.environment, "active_function_name",

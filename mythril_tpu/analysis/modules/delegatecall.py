@@ -9,6 +9,7 @@ import logging
 from ...core.state.global_state import GlobalState
 from ...core.transaction.symbolic import ACTORS
 from ...core.transaction.transaction_models import ContractCreationTransaction
+from ...smt import UGT, symbol_factory
 from ..module.base import DetectionModule, EntryPoint
 from ..potential_issues import PotentialIssue, get_potential_issues_annotation
 from ..swc_data import DELEGATECALL_TO_UNTRUSTED_CONTRACT
@@ -31,6 +32,12 @@ class ArbitraryDelegateCall(DetectionModule):
 
         constraints = [
             to == ACTORS.attacker,
+            # enough gas forwarded for meaningful reentry, and the call must
+            # succeed (reference delegatecall.py:49-57)
+            UGT(gas, symbol_factory.BitVecVal(2300, 256)),
+            state.new_bitvec(
+                f"retval_{state.get_current_instruction()['address']}",
+                256) == 1,
             *[transaction.caller == ACTORS.attacker
               for transaction in state.world_state.transaction_sequence
               if not isinstance(transaction, ContractCreationTransaction)],

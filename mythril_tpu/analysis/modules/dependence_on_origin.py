@@ -8,6 +8,7 @@ import logging
 
 from ...core.state.global_state import GlobalState
 from ...exceptions import UnsatError
+from ..issue_annotation import attach_issue_annotation
 from ..module.base import DetectionModule, EntryPoint
 from ..report import Issue
 from ..solver import get_transaction_sequence
@@ -41,12 +42,12 @@ class TxOrigin(DetectionModule):
         if not any(isinstance(annotation, OriginAnnotation)
                    for annotation in condition.annotations):
             return []
+        constraints = state.world_state.constraints.get_all_constraints()
         try:
-            transaction_sequence = get_transaction_sequence(
-                state, state.world_state.constraints.get_all_constraints())
+            transaction_sequence = get_transaction_sequence(state, constraints)
         except UnsatError:
             return []
-        return [Issue(
+        issue = Issue(
             contract=state.environment.active_account.contract_name,
             function_name=getattr(state.environment, "active_function_name",
                                   "fallback"),
@@ -64,4 +65,6 @@ class TxOrigin(DetectionModule):
                 "using msg.sender instead."),
             gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
             transaction_sequence=transaction_sequence,
-        )]
+        )
+        attach_issue_annotation(state, issue, self, constraints)
+        return [issue]

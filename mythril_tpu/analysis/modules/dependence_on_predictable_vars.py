@@ -1,14 +1,18 @@
 """SWC-116/120 block-value dependence (capability parity:
 mythril/analysis/module/modules/dependence_on_predictable_vars.py: TIMESTAMP /
-NUMBER / PREVRANDAO / COINBASE / GASLIMIT values influencing control flow ahead of
-an ether transfer, and BLOCKHASH of a predictable block)."""
+NUMBER / PREVRANDAO / COINBASE / GASLIMIT values influencing a control flow
+decision, and BLOCKHASH of a predictable (older) block number)."""
 
 from __future__ import annotations
 
 import logging
 
+from ...core.state.annotation import StateAnnotation
 from ...core.state.global_state import GlobalState
 from ...exceptions import UnsatError
+from ...smt import ULT, symbol_factory
+from ...support.model import get_model
+from ..issue_annotation import attach_issue_annotation
 from ..module.base import DetectionModule, EntryPoint
 from ..report import Issue
 from ..solver import get_transaction_sequence
@@ -21,19 +25,18 @@ PREDICTABLE_OPS = ["TIMESTAMP", "NUMBER", "COINBASE", "GASLIMIT", "PREVRANDAO",
 
 
 class PredictableValueAnnotation:
+    """Expression marker: value derives from a predictable block attribute."""
+
     def __init__(self, operation: str):
         self.operation = operation
 
 
-class PredictablePathAnnotation:
-    """State annotation: control flow already branched on a predictable value."""
-
-    def __init__(self, operation: str, location: int):
-        self.operation = operation
-        self.location = location
+class OldBlockNumberUsedAnnotation(StateAnnotation):
+    """State marker: BLOCKHASH was invoked with a provably older block number
+    (reference dependence_on_predictable_vars.py:40)."""
 
     def __copy__(self):
-        return PredictablePathAnnotation(self.operation, self.location)
+        return OldBlockNumberUsedAnnotation()
 
 
 class PredictableVariables(DetectionModule):
@@ -43,67 +46,82 @@ class PredictableVariables(DetectionModule):
                    "attributes (block.number, block.timestamp, block.prevrandao, "
                    "coinbase, gaslimit) or blockhash.")
     entry_point = EntryPoint.CALLBACK
-    pre_hooks = ["JUMPI", "BLOCKHASH", "CALL"]
-    post_hooks = PREDICTABLE_OPS
+    pre_hooks = ["JUMPI", "BLOCKHASH"]
+    post_hooks = PREDICTABLE_OPS + ["BLOCKHASH"]
 
     def _execute(self, state: GlobalState):
         instruction = state.get_current_instruction()
         opcode = instruction["opcode"]
-        if opcode not in ("JUMPI", "CALL", "BLOCKHASH"):
-            # post-hook on a block-value op (fires on the successor state):
-            # the producing instruction is the previous one
-            producer = state.environment.code.instruction_list[
-                state.mstate.pc - 1].op_code
-            operation = "block.timestamp" if producer == "TIMESTAMP" else \
-                f"block.{producer.lower()}"
-            state.mstate.stack[-1].annotate(PredictableValueAnnotation(operation))
-            return []
-
-        if opcode == "BLOCKHASH":
-            # pre-hook: blockhash of a predictable block is weak randomness
-            state.mstate.stack[-1].annotate(
-                PredictableValueAnnotation("blockhash"))
-            return []
 
         if opcode == "JUMPI":
-            condition = state.mstate.stack[-2]
-            markers = [annotation for annotation in condition.annotations
-                       if isinstance(annotation, PredictableValueAnnotation)]
-            if markers:
-                state.annotate(PredictablePathAnnotation(
-                    markers[0].operation, instruction["address"]))
+            # pre-hook: report every predictable value feeding the condition
+            issues = []
+            for marker in [a for a in state.mstate.stack[-2].annotations
+                           if isinstance(a, PredictableValueAnnotation)]:
+                constraints = state.world_state.constraints.get_all_constraints()
+                try:
+                    transaction_sequence = get_transaction_sequence(
+                        state, constraints)
+                except UnsatError:
+                    continue
+                operation = marker.operation
+                swc_id = (TIMESTAMP_DEPENDENCE if "timestamp" in operation
+                          else WEAK_RANDOMNESS)
+                issue = Issue(
+                    contract=state.environment.active_account.contract_name,
+                    function_name=getattr(state.environment,
+                                          "active_function_name", "fallback"),
+                    address=instruction["address"],
+                    swc_id=swc_id,
+                    bytecode=state.environment.code.bytecode,
+                    title="Dependence on predictable environment variable",
+                    severity="Low",
+                    description_head=f"A control flow decision is made based "
+                                     f"on {operation}.",
+                    description_tail=(
+                        f"{operation} is used to determine a control flow "
+                        "decision. Note that the values of variables like "
+                        "coinbase, gaslimit, block number and timestamp are "
+                        "predictable and can be manipulated by a malicious "
+                        "miner. Also keep in mind that attackers know hashes "
+                        "of earlier blocks. Don't use any of those environment "
+                        "variables as sources of randomness and be aware that "
+                        "use of these variables introduces a certain level of "
+                        "trust into miners."),
+                    gas_used=(state.mstate.min_gas_used,
+                              state.mstate.max_gas_used),
+                    transaction_sequence=transaction_sequence,
+                )
+                attach_issue_annotation(state, issue, self, constraints)
+                issues.append(issue)
+            return issues
+
+        if opcode == "BLOCKHASH":
+            # pre-hook: can the argument be an OLDER block number?
+            param = state.mstate.stack[-1]
+            block_number = state.environment.block_number
+            try:
+                get_model(tuple(
+                    state.world_state.constraints.get_all_constraints() + [
+                        ULT(param, block_number),
+                        # bound so the comparison cannot be satisfied by wrap
+                        ULT(block_number,
+                            symbol_factory.BitVecVal(2 ** 255, 256)),
+                    ]))
+                state.annotate(OldBlockNumberUsedAnnotation())
+            except Exception:
+                pass
             return []
 
-        # CALL with value, on a path that branched on a predictable value
-        annotations = [a for a in state.annotations
-                       if isinstance(a, PredictablePathAnnotation)]
-        if not annotations:
+        # post-hooks (successor state): the producing instruction is previous
+        producer = state.environment.code.instruction_list[
+            state.mstate.pc - 1].op_code
+        if producer == "BLOCKHASH":
+            if list(state.get_annotations(OldBlockNumberUsedAnnotation)):
+                state.mstate.stack[-1].annotate(PredictableValueAnnotation(
+                    "The block hash of a previous block"))
             return []
-        try:
-            transaction_sequence = get_transaction_sequence(
-                state, state.world_state.constraints.get_all_constraints())
-        except UnsatError:
-            return []
-        operation = annotations[0].operation
-        swc_id = TIMESTAMP_DEPENDENCE if "timestamp" in operation else WEAK_RANDOMNESS
-        return [Issue(
-            contract=state.environment.active_account.contract_name,
-            function_name=getattr(state.environment, "active_function_name",
-                                  "fallback"),
-            address=annotations[0].location,
-            swc_id=swc_id,
-            bytecode=state.environment.code.bytecode,
-            title="Dependence on predictable environment variable",
-            severity="Low",
-            description_head=f"A control flow decision is made based on "
-                             f"{operation}.",
-            description_tail=(
-                f"The {operation} environment variable is used to determine a "
-                "control flow decision ahead of an ether transfer. Note that the "
-                "values of variables like coinbase, gaslimit, block number and "
-                "timestamp are predictable and can be manipulated by a malicious "
-                "miner. Don't use them for random number generation or to make "
-                "critical control flow decisions."),
-            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-            transaction_sequence=transaction_sequence,
-        )]
+        operation = ("block.timestamp" if producer == "TIMESTAMP"
+                     else f"block.{producer.lower()}")
+        state.mstate.stack[-1].annotate(PredictableValueAnnotation(operation))
+        return []

@@ -8,8 +8,9 @@ import logging
 
 from ...core.state.global_state import GlobalState
 from ...core.transaction.symbolic import ACTORS
-from ...core.transaction.transaction_models import ContractCreationTransaction
-from ...smt import UGT, symbol_factory
+from ...exceptions import SolverTimeOutException, UnsatError
+from ...smt import UGT
+from ...support.model import get_model
 from ..module.base import DetectionModule, EntryPoint
 from ..potential_issues import PotentialIssue, get_potential_issues_annotation
 from ..swc_data import UNPROTECTED_ETHER_WITHDRAWAL
@@ -26,19 +27,23 @@ class EtherThief(DetectionModule):
     post_hooks = ["CALL", "STATICCALL"]
 
     def _execute(self, state: GlobalState):
-        # runs right after the CALL's post handler: inspect the completed transfer
+        # runs right after the CALL's post handler: inspect the completed
+        # transfer. Constraint set mirrors reference ether_thief.py:100-112:
+        # attacker profits, final tx sent directly by the attacker.
         world_state = state.world_state
-        constraints = []
-        for transaction in world_state.transaction_sequence:
-            if not isinstance(transaction, ContractCreationTransaction):
-                constraints.append(transaction.caller == ACTORS.attacker)
-                # the attacker does not fund the contract themselves beyond dust
-                constraints.append(transaction.call_value == 0)
+        constraints = [
+            UGT(world_state.balances[ACTORS.attacker],
+                world_state.starting_balances[ACTORS.attacker]),
+            state.environment.sender == ACTORS.attacker,
+            state.current_transaction.caller == state.current_transaction.origin,
+        ]
 
-        # attacker's final balance strictly exceeds their starting balance
-        constraints.append(UGT(
-            world_state.balances[ACTORS.attacker],
-            world_state.starting_balances[ACTORS.attacker]))
+        # pre-solve so a potential issue is only recorded on feasible profit
+        try:
+            get_model(tuple(world_state.constraints.get_all_constraints()
+                            + constraints))
+        except (UnsatError, SolverTimeOutException):
+            return []
 
         potential_issue = PotentialIssue(
             contract=state.environment.active_account.contract_name,

@@ -1,6 +1,7 @@
 """SWC-107 External call to user-supplied address (capability parity:
-mythril/analysis/module/modules/external_calls.py: CALL with attacker-controlled
-target and non-trivial forwarded gas => reentrancy surface)."""
+mythril/analysis/module/modules/external_calls.py: CALL with
+attacker-controlled target and more than stipend gas forwarded => reentrancy
+surface; two-phase PotentialIssue flow)."""
 
 from __future__ import annotations
 
@@ -10,9 +11,8 @@ from ...core.state.global_state import GlobalState
 from ...core.transaction.symbolic import ACTORS
 from ...exceptions import UnsatError
 from ...smt import UGT, symbol_factory
-from ...support.model import get_model
 from ..module.base import DetectionModule, EntryPoint
-from ..report import Issue
+from ..potential_issues import PotentialIssue, get_potential_issues_annotation
 from ..solver import get_transaction_sequence
 from ..swc_data import REENTRANCY
 
@@ -22,56 +22,52 @@ log = logging.getLogger(__name__)
 class ExternalCalls(DetectionModule):
     name = "External call to another contract"
     swc_id = REENTRANCY
-    description = ("Check whether there is a state change of the contract after "
-                   "the execution of an external call")
+    description = ("Check for external calls with enough forwarded gas for the "
+                   "callee to re-enter (reference external_calls.py).")
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["CALL"]
 
     def _execute(self, state: GlobalState):
+        if getattr(state.environment, "active_function_name",
+                   "") == "constructor":
+            return []
+
         gas = state.mstate.stack[-1]
         to = state.mstate.stack[-2]
-        if to.raw.is_const and to.value <= 10:
-            return []  # precompile
-        base = state.world_state.constraints.get_all_constraints()
+
+        # enough gas forwarded for the callee to do damage (the 2300 stipend
+        # is reentrancy-safe), target steerable to the attacker
+        constraints = [UGT(gas, symbol_factory.BitVecVal(2300, 256)),
+                       to == ACTORS.attacker]
         try:
-            # enough gas forwarded for the callee to do damage (2300 stipend is safe)
-            constraints = base + [UGT(gas, symbol_factory.BitVecVal(2300, 256))]
-            if not to.raw.is_const:
-                constraints.append(to == ACTORS.attacker)
-            transaction_sequence = get_transaction_sequence(state, constraints)
+            get_transaction_sequence(
+                state,
+                state.world_state.constraints.get_all_constraints()
+                + constraints)
         except UnsatError:
             return []
-        if not to.raw.is_const:
-            description_head = ("A call to a user-supplied address is executed.")
-            description_tail = (
+
+        potential_issue = PotentialIssue(
+            contract=state.environment.active_account.contract_name,
+            function_name=getattr(state.environment, "active_function_name",
+                                  "fallback"),
+            address=state.get_current_instruction()["address"],
+            swc_id=self.swc_id,
+            title="External Call To User-Supplied Address",
+            bytecode=state.environment.code.bytecode,
+            severity="Low",
+            description_head="A call to a user-supplied address is executed.",
+            description_tail=(
                 "An external message call to an address specified by the caller "
                 "is executed. Note that the callee account might contain "
                 "arbitrary code and could re-enter any function within this "
                 "contract. Reentering the contract in an intermediate state may "
                 "lead to unexpected behaviour. Make sure that no state "
                 "modifications are executed after this call and/or reentrancy "
-                "guards are in place.")
-            severity = "Low"
-        else:
-            description_head = ("An external function call to a fixed contract "
-                                "address is executed.")
-            description_tail = (
-                "Calling external contracts opens the opportunity for the callee "
-                "to re-enter. Make sure that no state modifications are executed "
-                "after this call and/or reentrancy guards are in place.")
-            severity = "Low"
-        return [Issue(
-            contract=state.environment.active_account.contract_name,
-            function_name=getattr(state.environment, "active_function_name",
-                                  "fallback"),
-            address=state.get_current_instruction()["address"],
-            swc_id=self.swc_id,
-            title="External Call To User-Supplied Address"
-            if not to.raw.is_const else "External Call To Fixed Address",
-            severity=severity,
-            bytecode=state.environment.code.bytecode,
-            description_head=description_head,
-            description_tail=description_tail,
-            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-            transaction_sequence=transaction_sequence,
-        )]
+                "guards are in place."),
+            constraints=constraints,
+            detector=self,
+        )
+        get_potential_issues_annotation(state).potential_issues.append(
+            potential_issue)
+        return []

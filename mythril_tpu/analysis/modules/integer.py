@@ -22,6 +22,7 @@ from ...smt import (BVAddNoOverflow, BVMulNoOverflow, BVSubNoUnderflow,
 from ...support.model import get_model
 from ..module.base import DetectionModule, EntryPoint
 from ..report import Issue
+from ..issue_annotation import attach_issue_annotation
 from ..solver import get_transaction_sequence
 from ..swc_data import INTEGER_OVERFLOW_AND_UNDERFLOW
 
@@ -189,14 +190,14 @@ class IntegerArithmetics(DetectionModule):
                 except Exception:
                     self._ostates_unsatisfiable.add(ostate_key)
                     continue
+            constraints = (state.world_state.constraints.get_all_constraints()
+                           + [annotation.constraint])
             try:
                 transaction_sequence = get_transaction_sequence(
-                    state,
-                    state.world_state.constraints.get_all_constraints()
-                    + [annotation.constraint])
+                    state, constraints)
             except UnsatError:
                 continue
-            issues.append(Issue(
+            issue = Issue(
                 contract=ostate.environment.active_account.contract_name,
                 function_name=getattr(ostate.environment,
                                       "active_function_name", "fallback"),
@@ -217,5 +218,7 @@ class IntegerArithmetics(DetectionModule):
                     "it."),
                 gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
                 transaction_sequence=transaction_sequence,
-            ))
+            )
+            attach_issue_annotation(state, issue, self, constraints)
+            issues.append(issue)
         return issues

@@ -9,6 +9,7 @@ import logging
 from ...core.state.annotation import StateAnnotation
 from ...core.state.global_state import GlobalState
 from ...exceptions import UnsatError
+from ..issue_annotation import attach_issue_annotation
 from ..module.base import DetectionModule, EntryPoint
 from ..report import Issue
 from ..solver import get_transaction_sequence
@@ -51,12 +52,12 @@ class MultipleSends(DetectionModule):
         # RETURN/STOP: report if more than one external call happened
         if len(annotation.call_offsets) < 2:
             return []
+        constraints = state.world_state.constraints.get_all_constraints()
         try:
-            transaction_sequence = get_transaction_sequence(
-                state, state.world_state.constraints.get_all_constraints())
+            transaction_sequence = get_transaction_sequence(state, constraints)
         except UnsatError:
             return []
-        return [Issue(
+        issue = Issue(
             contract=state.environment.active_account.contract_name,
             function_name=getattr(state.environment, "active_function_name",
                                   "fallback"),
@@ -77,4 +78,6 @@ class MultipleSends(DetectionModule):
                 "they're part of your own codebase)."),
             gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
             transaction_sequence=transaction_sequence,
-        )]
+        )
+        attach_issue_annotation(state, issue, self, constraints)
+        return [issue]

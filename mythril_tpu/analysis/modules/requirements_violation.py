@@ -8,6 +8,7 @@ import logging
 
 from ...core.state.global_state import GlobalState
 from ...exceptions import UnsatError
+from ..issue_annotation import attach_issue_annotation
 from ..module.base import DetectionModule, EntryPoint
 from ..report import Issue
 from ..solver import get_transaction_sequence
@@ -29,12 +30,12 @@ class RequirementsViolation(DetectionModule):
         # passed inputs that violate the callee's requirement)
         if len(state.transaction_stack) < 2:
             return []
+        constraints = state.world_state.constraints.get_all_constraints()
         try:
-            transaction_sequence = get_transaction_sequence(
-                state, state.world_state.constraints.get_all_constraints())
+            transaction_sequence = get_transaction_sequence(state, constraints)
         except UnsatError:
             return []
-        return [Issue(
+        issue = Issue(
             contract=state.environment.active_account.contract_name,
             function_name=getattr(state.environment, "active_function_name",
                                   "fallback"),
@@ -52,4 +53,6 @@ class RequirementsViolation(DetectionModule):
                 "arguments that violate the callee's preconditions."),
             gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
             transaction_sequence=transaction_sequence,
-        )]
+        )
+        attach_issue_annotation(state, issue, self, constraints)
+        return [issue]

@@ -10,6 +10,8 @@ from ...core.state.global_state import GlobalState
 from ...core.transaction.symbolic import ACTORS
 from ...core.transaction.transaction_models import ContractCreationTransaction
 from ...exceptions import UnsatError
+from ...smt import And
+from ..issue_annotation import attach_issue_annotation
 from ..module.base import DetectionModule, EntryPoint
 from ..report import Issue
 from ..solver import get_transaction_sequence
@@ -34,19 +36,22 @@ class AccidentallyKillable(DetectionModule):
         log.debug("SELFDESTRUCT found at pc %d", instruction["address"])
 
         # Only attacker-triggerable kills count: every tx in the sequence must be
-        # sendable by the attacker (reference suicide.py:62-78).
+        # sendable by the attacker directly — caller == origin suppresses
+        # contract-mediated false positives (reference suicide.py:66-69).
         attacker_constraints = []
         for transaction in state.world_state.transaction_sequence:
             if not isinstance(transaction, ContractCreationTransaction):
-                attacker_constraints.append(
-                    transaction.caller == ACTORS.attacker)
+                attacker_constraints.append(And(
+                    transaction.caller == ACTORS.attacker,
+                    transaction.caller == transaction.origin))
         base = state.world_state.constraints.get_all_constraints()
 
         description_head = "Any sender can cause the contract to self-destruct."
         try:
             try:
+                constraints = base + attacker_constraints + [to == ACTORS.attacker]
                 transaction_sequence = get_transaction_sequence(
-                    state, base + attacker_constraints + [to == ACTORS.attacker])
+                    state, constraints)
                 description_tail = (
                     "Any sender can trigger execution of the SELFDESTRUCT "
                     "instruction to destroy this contract account and withdraw "
@@ -55,15 +60,16 @@ class AccidentallyKillable(DetectionModule):
                     "appropriate security controls are in place to prevent "
                     "unrestricted access.")
             except UnsatError:
+                constraints = base + attacker_constraints
                 transaction_sequence = get_transaction_sequence(
-                    state, base + attacker_constraints)
+                    state, constraints)
                 description_tail = (
                     "Any sender can trigger execution of the SELFDESTRUCT "
                     "instruction to destroy this contract account. Review the "
                     "transaction trace generated for this issue and make sure "
                     "that appropriate security controls are in place to prevent "
                     "unrestricted access.")
-            return [Issue(
+            issue = Issue(
                 contract=state.environment.active_account.contract_name,
                 function_name=getattr(state.environment, "active_function_name",
                                       "fallback"),
@@ -76,7 +82,9 @@ class AccidentallyKillable(DetectionModule):
                 description_tail=description_tail,
                 transaction_sequence=transaction_sequence,
                 gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-            )]
+            )
+            attach_issue_annotation(state, issue, self, constraints)
+            return [issue]
         except UnsatError:
             log.debug("no model found for killable path")
         return []

@@ -1,44 +1,72 @@
 """SWC-114 Transaction order dependence (capability parity:
-mythril/analysis/module/modules/transaction_order_dependence.py: the value or
-target of an ether transfer depends on storage another transaction can change)."""
+mythril/analysis/module/modules/transaction_order_dependence.py: the value of
+an ether transfer is tainted by BALANCE/SLOAD reads whose writer another
+(attacker) transaction could be — front-runnable race; two-phase
+PotentialIssue flow)."""
 
 from __future__ import annotations
 
 import logging
 
 from ...core.state.global_state import GlobalState
-from ...exceptions import UnsatError
-from ...smt import UGT, symbol_factory, terms
+from ...core.transaction.symbolic import ACTORS
+from ...smt import Or, symbol_factory
 from ..module.base import DetectionModule, EntryPoint
-from ..report import Issue
-from ..solver import get_transaction_sequence
+from ..potential_issues import PotentialIssue, get_potential_issues_annotation
 from ..swc_data import TX_ORDER_DEPENDENCE
 
 log = logging.getLogger(__name__)
 
 
+class BalanceAnnotation:
+    def __init__(self, caller):
+        self.caller = caller
+
+
+class StorageAnnotation:
+    def __init__(self, caller):
+        self.caller = caller
+
+
 class TxOrderDependence(DetectionModule):
-    name = "Transaction order dependence"
+    name = "Transaction Order Dependence"
     swc_id = TX_ORDER_DEPENDENCE
-    description = ("Check whether the value or target of an ether transfer "
-                   "depends on mutable storage (front-runnable).")
+    description = "Search for calls whose value depends on balance or storage."
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["CALL"]
+    post_hooks = ["BALANCE", "SLOAD"]
+
+    @staticmethod
+    def _annotate_read(state: GlobalState, opcode: str):
+        value = state.mstate.stack[-1]
+        annotation_type = (BalanceAnnotation if opcode == "BALANCE"
+                           else StorageAnnotation)
+        if not list(value.get_annotations(annotation_type)):
+            value.annotate(annotation_type(state.environment.sender))
+        return []
 
     def _execute(self, state: GlobalState):
+        opcode = state.get_current_instruction()["opcode"]
+        if opcode != "CALL":
+            opcode = state.environment.code.instruction_list[
+                state.mstate.pc - 1].op_code
+        if opcode in ("BALANCE", "SLOAD"):
+            return self._annotate_read(state, opcode)
+
         value = state.mstate.stack[-3]
-        to = state.mstate.stack[-2]
-        # the transfer is order-dependent when value or target reads storage
-        if not (_depends_on_storage(value) or _depends_on_storage(to)):
+        storage_annotations = list(value.get_annotations(StorageAnnotation))
+        balance_annotations = list(value.get_annotations(BalanceAnnotation))
+        if not storage_annotations and not balance_annotations:
             return []
-        try:
-            transaction_sequence = get_transaction_sequence(
-                state,
-                state.world_state.constraints.get_all_constraints()
-                + [UGT(value, symbol_factory.BitVecVal(0, 256))])
-        except UnsatError:
-            return []
-        return [Issue(
+        callers = [a.caller for a in storage_annotations[:1]] + \
+                  [a.caller for a in balance_annotations[:1]]
+
+        # the competing writer transaction must be attacker-sendable
+        call_constraint = symbol_factory.BoolVal(False)
+        for caller in callers:
+            call_constraint = Or(call_constraint, ACTORS.attacker == caller)
+
+        potential_issue = PotentialIssue(
             contract=state.environment.active_account.contract_name,
             function_name=getattr(state.environment, "active_function_name",
                                   "fallback"),
@@ -47,22 +75,15 @@ class TxOrderDependence(DetectionModule):
             bytecode=state.environment.code.bytecode,
             title="Transaction Order Dependence",
             severity="Medium",
-            description_head="The value of the call is dependent on storage "
-                             "that other transactions can modify.",
+            description_head="The value of the call is dependent on balance "
+                             "or storage write",
             description_tail=(
-                "The value or target of this ether transfer is read from "
-                "contract storage. Another pending transaction that writes "
-                "this storage can front-run this transfer and change its "
-                "outcome (race condition / SWC-114). Consider using "
-                "pull-payment patterns or commit-reveal schemes."),
-            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-            transaction_sequence=transaction_sequence,
-        )]
-
-
-def _depends_on_storage(expression) -> bool:
-    for node in terms.walk(expression.raw):
-        if node.op == "select" or (node.op == "var" and
-                                   str(node.params[0]).startswith("Storage[")):
-            return True
-    return False
+                "This can lead to race conditions. An attacker may be able to "
+                "run a transaction after our transaction which can change the "
+                "value of the call"),
+            detector=self,
+            constraints=[call_constraint],
+        )
+        get_potential_issues_annotation(state).potential_issues.append(
+            potential_issue)
+        return []

@@ -10,6 +10,8 @@ from typing import List
 from ...core.state.annotation import StateAnnotation
 from ...core.state.global_state import GlobalState
 from ...exceptions import UnsatError
+from ...smt import And
+from ..issue_annotation import IssueAnnotation
 from ..module.base import DetectionModule, EntryPoint
 from ..report import Issue
 from ..solver import get_transaction_sequence
@@ -68,7 +70,7 @@ class UncheckedRetval(DetectionModule):
                     state, base + [retval == 0])
             except UnsatError:
                 continue
-            issues.append(Issue(
+            issue = Issue(
                 contract=state.environment.active_account.contract_name,
                 function_name=getattr(state.environment,
                                       "active_function_name", "fallback"),
@@ -89,5 +91,10 @@ class UncheckedRetval(DetectionModule):
                     "reverted if the call fails."),
                 gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
                 transaction_sequence=transaction_sequence,
-            ))
+            )
+            state.annotate(IssueAnnotation(
+                conditions=[And(*(base + [retval == 1])),
+                            And(*(base + [retval == 0]))],
+                issue=issue, detector=self))
+            issues.append(issue)
         return issues

@@ -9,6 +9,7 @@ import logging
 from ...core.state.annotation import StateAnnotation
 from ...core.state.global_state import GlobalState
 from ...exceptions import UnsatError
+from ..issue_annotation import attach_issue_annotation
 from ..module.base import DetectionModule, EntryPoint
 from ..report import Issue
 from ..solver import get_transaction_sequence
@@ -44,12 +45,12 @@ class UnexpectedEther(DetectionModule):
         # strict equality on balance: an eq term over a balance-tainted value
         if not _contains_strict_equality(condition):
             return []
+        constraints = state.world_state.constraints.get_all_constraints()
         try:
-            transaction_sequence = get_transaction_sequence(
-                state, state.world_state.constraints.get_all_constraints())
+            transaction_sequence = get_transaction_sequence(state, constraints)
         except UnsatError:
             return []
-        return [Issue(
+        issue = Issue(
             contract=state.environment.active_account.contract_name,
             function_name=getattr(state.environment, "active_function_name",
                                   "fallback"),
@@ -68,7 +69,9 @@ class UnexpectedEther(DetectionModule):
                 "by an attacker, potentially locking the contract's logic."),
             gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
             transaction_sequence=transaction_sequence,
-        )]
+        )
+        attach_issue_annotation(state, issue, self, constraints)
+        return [issue]
 
 
 def _contains_strict_equality(condition) -> bool:

@@ -1,6 +1,8 @@
 """SWC-110 user-level assertion reporting (capability parity:
-mythril/analysis/module/modules/user_assertions.py: decodes Panic(uint256) and
-assert-style revert payloads)."""
+mythril/analysis/module/modules/user_assertions.py — `emit
+AssertionFailed(string)` events via LOG1 and the 0xcafecafe... MSTORE
+property-check pattern; Panic(uint256) reverts are handled by the
+`exceptions` module)."""
 
 from __future__ import annotations
 
@@ -8,7 +10,8 @@ import logging
 
 from ...core.state.global_state import GlobalState
 from ...exceptions import UnsatError
-from ...smt import BitVec
+from ...smt import Extract
+from ..issue_annotation import attach_issue_annotation
 from ..module.base import DetectionModule, EntryPoint
 from ..report import Issue
 from ..solver import get_transaction_sequence
@@ -16,60 +19,68 @@ from ..swc_data import ASSERT_VIOLATION
 
 log = logging.getLogger(__name__)
 
-PANIC_SELECTOR = 0x4E487B71  # keccak("Panic(uint256)")[:4]
-ERROR_SELECTOR = 0x08C379A0  # keccak("Error(string)")[:4]
+#: keccak("AssertionFailed(string)")
+ASSERTION_FAILED_HASH = \
+    0xB42604CB105A16C8F6DB8A41E6B00C0C1B4826465E8BC504B3EB3E88B3E6A4A0
 
-PANIC_CODES = {
-    0x01: "generic assert violation",
-    0x11: "arithmetic overflow/underflow (checked arithmetic)",
-    0x12: "division by zero",
-    0x21: "enum conversion out of range",
-    0x31: "pop on empty array",
-    0x32: "array index out of bounds",
-    0x41: "allocation of too much memory",
-    0x51: "call to a zero-initialized internal function",
-}
+#: MythX-style property-check marker written via MSTORE
+MSTORE_PATTERN = "0xcafecafecafecafecafecafecafecafecafecafecafecafecafecafecafe"
+
+
+def _decode_abi_string(data: list) -> str:
+    """Hand-decoded `abi.encode(string)` tail: 32-byte length + bytes."""
+    if len(data) < 32:
+        return ""
+    if not all(b.raw.is_const for b in data[:32]):
+        return ""
+    length = int.from_bytes(bytes(b.value for b in data[:32]), "big")
+    if length > len(data) - 32:
+        return ""
+    payload = data[32:32 + length]
+    if not all(b.raw.is_const for b in payload):
+        return ""
+    return bytes(b.value for b in payload).decode("utf-8", errors="replace")
 
 
 class UserAssertions(DetectionModule):
     name = "A user-defined assertion has been triggered"
     swc_id = ASSERT_VIOLATION
-    description = "Search for reachable user-supplied exceptions (Panic/Error reverts)."
+    description = ("Search for reachable user-supplied exceptions: "
+                   "emit AssertionFailed(string).")
     entry_point = EntryPoint.CALLBACK
-    pre_hooks = ["REVERT"]
+    pre_hooks = ["LOG1", "MSTORE"]
 
     def _execute(self, state: GlobalState):
-        offset, length = state.mstate.stack[-1], state.mstate.stack[-2]
-        if not (offset.raw.is_const and length.raw.is_const):
-            return []
-        size = length.value
-        if size < 4:
-            return []
-        data = state.mstate.memory[offset.value:offset.value + min(size, 68)]
-        if not all(isinstance(b, BitVec) and b.raw.is_const for b in data[:4]):
-            return []
-        selector = int.from_bytes(bytes(b.value for b in data[:4]), "big")
-        if selector == PANIC_SELECTOR and size >= 36:
-            code_bytes = data[4:36]
-            if all(b.raw.is_const for b in code_bytes):
-                panic_code = int.from_bytes(
-                    bytes(b.value for b in code_bytes), "big")
-                if panic_code not in PANIC_CODES:
-                    return []
-                detail = PANIC_CODES[panic_code]
-            else:
-                detail = "panic with symbolic code"
-        elif selector == ERROR_SELECTOR:
-            detail = "require()/revert() with reason string"
-            return []  # plain require failures are not assertion violations
-        else:
-            return []
+        opcode = state.get_current_instruction()["opcode"]
+        message = None
+        if opcode == "MSTORE":
+            value = state.mstate.stack[-2]
+            if not value.raw.is_const:
+                return []
+            if MSTORE_PATTERN not in hex(value.raw.value)[:126]:
+                return []
+            message = f"Failed property id {Extract(15, 0, value).raw.value}"
+        else:  # LOG1
+            topic, size, mem_start = state.mstate.stack[-3:]
+            if not topic.raw.is_const or topic.raw.value != ASSERTION_FAILED_HASH:
+                return []
+            if mem_start.raw.is_const and size.raw.is_const:
+                data = state.mstate.memory[
+                    mem_start.raw.value + 32:
+                    mem_start.raw.value + size.raw.value]
+                decoded = _decode_abi_string(data)
+                if decoded:
+                    message = decoded
+
+        constraints = state.world_state.constraints.get_all_constraints()
         try:
-            transaction_sequence = get_transaction_sequence(
-                state, state.world_state.constraints.get_all_constraints())
+            transaction_sequence = get_transaction_sequence(state, constraints)
         except UnsatError:
             return []
-        return [Issue(
+        description_tail = (
+            f"A user-provided assertion failed with the message '{message}'"
+            if message else "A user-provided assertion failed.")
+        issue = Issue(
             contract=state.environment.active_account.contract_name,
             function_name=getattr(state.environment, "active_function_name",
                                   "fallback"),
@@ -79,8 +90,9 @@ class UserAssertions(DetectionModule):
             severity="Medium",
             bytecode=state.environment.code.bytecode,
             description_head="A user-provided assertion failed.",
-            description_tail=f"A reachable user-level assertion failure was "
-                             f"found: {detail}.",
+            description_tail=description_tail,
             gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
             transaction_sequence=transaction_sequence,
-        )]
+        )
+        attach_issue_annotation(state, issue, self, constraints)
+        return [issue]

@@ -11,7 +11,9 @@ from __future__ import annotations
 from ..core.state.annotation import StateAnnotation
 from ..core.state.global_state import GlobalState
 from ..exceptions import UnsatError
+from ..support.support_args import args
 from ..utils.helpers import get_code_hash
+from .issue_annotation import attach_issue_annotation
 from .report import Issue
 from .solver import get_transaction_sequence
 
@@ -70,20 +72,27 @@ def check_potential_issues(state: GlobalState) -> None:
         except UnsatError:
             unsat_issues.append(potential_issue)
             continue
-        potential_issue.detector.cache.add(
-            (potential_issue.address, get_code_hash(potential_issue.bytecode)))
-        potential_issue.detector.issues.append(
-            Issue(
-                contract=potential_issue.contract,
-                function_name=potential_issue.function_name,
-                address=potential_issue.address,
-                title=potential_issue.title,
-                bytecode=potential_issue.bytecode,
-                swc_id=potential_issue.swc_id,
-                gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
-                description_head=potential_issue.description_head,
-                description_tail=potential_issue.description_tail,
-                severity=potential_issue.severity,
-                transaction_sequence=transaction_sequence,
-            ))
+        issue = Issue(
+            contract=potential_issue.contract,
+            function_name=potential_issue.function_name,
+            address=potential_issue.address,
+            title=potential_issue.title,
+            bytecode=potential_issue.bytecode,
+            swc_id=potential_issue.swc_id,
+            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
+            description_head=potential_issue.description_head,
+            description_tail=potential_issue.description_tail,
+            severity=potential_issue.severity,
+            transaction_sequence=transaction_sequence,
+        )
+        attach_issue_annotation(
+            state, issue, potential_issue.detector,
+            list(state.world_state.constraints) + list(potential_issue.constraints))
+        # deferred mode (--enable-summaries): the summary plugin promotes
+        # validated annotations instead (reference potential_issues.py:123-125)
+        if not args.use_issue_annotations:
+            potential_issue.detector.issues.append(issue)
+            potential_issue.detector.cache.add(
+                (potential_issue.address,
+                 get_code_hash(potential_issue.bytecode)))
     annotation.potential_issues = unsat_issues

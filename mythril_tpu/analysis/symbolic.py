@@ -12,6 +12,7 @@ from ..core.plugin import LaserPluginLoader
 from ..core.plugin.plugins import (BenchmarkPluginBuilder, CallDepthLimitBuilder,
                                    CoverageMetricsPluginBuilder,
                                    CoveragePluginBuilder, DependencyPrunerBuilder,
+                                   StateMergePluginBuilder,
                                    InstructionProfilerBuilder,
                                    MutationPrunerBuilder)
 from ..core.strategy import (BasicSearchStrategy, BeamSearch,
@@ -63,6 +64,20 @@ class SymExecWrapper:
                 EntryPoint.POST, modules)) > 0
         self.modules = modules
         tx_id_manager.restart_counter()
+        # a fresh analysis must not inherit another's keccak axioms: with
+        # restarted tx ids, symbol names recur and stale concrete-hash
+        # conditions would conflict with this run's (making everything unsat)
+        from ..core.function_managers import keccak_function_manager
+
+        keccak_function_manager.reset()
+
+        # non-incremental exploration: the RF prioritizer predicts which
+        # function sequence to explore (reference symbolic.py:107-110)
+        tx_strategy = None
+        if not args.incremental_txs:
+            from ..core.tx_prioritiser import RfTxPrioritiser
+
+            tx_strategy = RfTxPrioritiser(contract)
 
         self.laser = LaserEVM(
             dynamic_loader=dynloader,
@@ -72,6 +87,7 @@ class SymExecWrapper:
             strategy=strategy_class,
             transaction_count=transaction_count,
             requires_statespace=requires_statespace,
+            tx_strategy=tx_strategy,
         )
         if loop_bound is not None:
             self.laser.extend_strategy(BoundedLoopsStrategy,
@@ -90,6 +106,16 @@ class SymExecWrapper:
                                call_depth_limit=args.call_depth_limit)
         if not disable_dependency_pruning:
             plugin_loader.load(DependencyPrunerBuilder())
+        if args.enable_state_merging:
+            plugin_loader.load(StateMergePluginBuilder())
+        # issue emission is deferred to summary validation only while the
+        # summary plugin is active (it must not leak into later analyses in
+        # the same process)
+        args.use_issue_annotations = args.enable_summaries
+        if args.enable_summaries:
+            from ..core.plugin.plugins.summary import SummaryPluginBuilder
+
+            plugin_loader.load(SummaryPluginBuilder())
         plugin_loader.instrument_virtual_machine(self.laser, None)
 
         self.plugin_loader = plugin_loader

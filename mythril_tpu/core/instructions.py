@@ -26,6 +26,7 @@ from ..utils.helpers import TT256, ceil32
 from ..utils.keccak import keccak256
 from .function_managers import exponent_function_manager, keccak_function_manager
 from .call import (SYMBOLIC_CALLDATA_SIZE, get_call_parameters, native_call)
+from .cheat_code import handle_cheat_codes, hevm_cheat_code
 from .state.calldata import ConcreteCalldata
 from .state.global_state import GlobalState
 from .state.return_data import ReturnData
@@ -827,6 +828,16 @@ class Instruction:
             for state in native_result:
                 state.mstate.pc += 1
             return native_result
+
+        # hevm/forge cheat addresses: modeled as unconditional success
+        # (core/cheat_code.py; reference call.py routes these before any
+        # account resolution)
+        if isinstance(callee_address, str) and \
+                hevm_cheat_code.is_cheat_address(callee_address):
+            handle_cheat_codes(s, callee_address, call_data,
+                               memory_out_offset, memory_out_size)
+            s.mstate.pc += 1
+            return [s]
 
         if callee_account is None or (isinstance(callee_address, BitVec)
                                       and not callee_address.raw.is_const):

@@ -6,9 +6,11 @@ from .coverage_metrics import CoverageMetricsPluginBuilder
 from .instruction_profiler import InstructionProfilerBuilder
 from .benchmark import BenchmarkPluginBuilder
 from .trace import TraceFinderBuilder
+from .state_merge import StateMergePluginBuilder
 
 __all__ = [
     "MutationPrunerBuilder", "DependencyPrunerBuilder", "CallDepthLimitBuilder",
     "CoveragePluginBuilder", "CoverageMetricsPluginBuilder",
     "InstructionProfilerBuilder", "BenchmarkPluginBuilder", "TraceFinderBuilder",
+    "StateMergePluginBuilder",
 ]

@@ -133,10 +133,15 @@ class LaserEVM:
             hook()
 
     def execute_transactions(self, address) -> None:
-        """Drive `transaction_count` message-call transactions (reference svm.py:220)."""
+        """Drive `transaction_count` message-call transactions (reference svm.py:220).
+
+        With a tx_strategy (RF prioritizer, `--incremental-txs False`), each
+        transaction is restricted to the predicted function's selector
+        (reference svm.py:241 _execute_transactions_non_ordered)."""
         self.executed_transactions = True
         time_handler.start_execution(self.execution_timeout)
         self.time = datetime.now()
+        predicted_hashes = self._predicted_function_hashes(address)
         for i in range(self.transaction_count):
             if len(self.open_states) == 0:
                 log.info("no open states left, ending transaction sequence")
@@ -153,9 +158,49 @@ class LaserEVM:
                      "%d initial states", i, len(self.open_states))
             for hook in self._start_sym_trans_hooks:
                 hook()
-            execute_message_call(self, address)
+            execute_message_call(
+                self, address,
+                func_hashes=(predicted_hashes[i]
+                             if i < len(predicted_hashes) else None))
             for hook in self._stop_sym_trans_hooks:
                 hook()
+
+    def _predicted_function_hashes(self, address) -> List[Optional[List]]:
+        """Map the tx_strategy's predicted function indices to 4-byte
+        selectors (one singleton list per upcoming transaction)."""
+        if self.tx_strategy is None:
+            return []
+        try:
+            sequence = self.tx_strategy.__next__(address)
+        except Exception as error:
+            log.warning("tx prioritizer failed (%s); falling back to "
+                        "unordered exploration", error)
+            return []
+        if not sequence:
+            return []
+        log.info("tx prioritizer predicted function sequence: %s", sequence)
+        hashes: List[Optional[List]] = []
+        for function_index in sequence:
+            selector = self._selector_for_function_index(function_index)
+            hashes.append([selector] if selector is not None else None)
+        return hashes
+
+    def _selector_for_function_index(self, function_index: int):
+        """Predicted function index -> 4-byte selector (as bytes, the format
+        generate_function_constraints consumes), matched by the recovered
+        function name on any open account's dispatcher table."""
+        names = getattr(self.tx_strategy, "function_names", [])
+        if not (0 <= function_index < len(names)):
+            return None
+        bare_name = names[function_index]
+        for state in self.open_states:
+            for account in state.accounts.values():
+                table = getattr(account.code, "function_name_to_hash", {})
+                for recovered, selector in table.items():
+                    if recovered == bare_name or \
+                            recovered.startswith(f"{bare_name}("):
+                        return bytes.fromhex(selector[2:].rjust(8, "0"))
+        return None
 
     # -- main loop --------------------------------------------------------------------
     def exec(self, create: bool = False, track_gas: bool = False) -> Optional[List[GlobalState]]:
@@ -207,7 +252,9 @@ class LaserEVM:
             for hook in self._execute_state_hooks:
                 hook(global_state)
         except PluginSkipState:
-            self._add_world_state(global_state)
+            # drop the state (reference svm.py:410-414): pruners raise this
+            # when the path cannot add new behavior; summaries raise it after
+            # replaying the recorded effect as a fresh open state
             return [], None
 
         # stack preflight (reference svm.py:423-434)

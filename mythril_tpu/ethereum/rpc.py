@@ -84,3 +84,42 @@ class EthJsonRpc:
 
     def eth_blockNumber(self) -> int:
         return int(self._call("eth_blockNumber"), 16)
+
+    def eth_coinbase(self) -> str:
+        return self._call("eth_coinbase")
+
+    def eth_getBlockByNumber(self, block="latest",
+                             tx_objects: bool = True) -> dict:
+        if isinstance(block, int):
+            block = hex(block)
+        return self._call("eth_getBlockByNumber", [block, tx_objects])
+
+    def eth_getBlockByHash(self, block_hash: str,
+                           tx_objects: bool = True) -> dict:
+        return self._call("eth_getBlockByHash", [block_hash, tx_objects])
+
+    def eth_getTransactionByHash(self, tx_hash: str) -> dict:
+        return self._call("eth_getTransactionByHash", [tx_hash])
+
+    def eth_getTransactionCount(self, address, block: str = "latest") -> int:
+        return int(self._call("eth_getTransactionCount",
+                              [self._addr(address), block]), 16)
+
+    def eth_gasPrice(self) -> int:
+        return int(self._call("eth_gasPrice"), 16)
+
+    def eth_call(self, to, data: str = "0x", block: str = "latest") -> str:
+        return self._call("eth_call",
+                          [{"to": self._addr(to), "data": data}, block])
+
+    def eth_estimateGas(self, transaction: dict) -> int:
+        return int(self._call("eth_estimateGas", [transaction]), 16)
+
+    def eth_sendRawTransaction(self, raw: str) -> str:
+        return self._call("eth_sendRawTransaction", [raw])
+
+    def net_version(self) -> str:
+        return self._call("net_version")
+
+    def web3_clientVersion(self) -> str:
+        return self._call("web3_clientVersion")

@@ -102,6 +102,7 @@ class Disassembly:
     func_hashes: List[str] = field(default_factory=list)
     function_name_to_address: Dict[str, int] = field(default_factory=dict)
     address_to_function_name: Dict[int, str] = field(default_factory=dict)
+    function_name_to_hash: Dict[str, str] = field(default_factory=dict)
 
     def __post_init__(self):
         code = _normalize(self.bytecode)
@@ -156,6 +157,7 @@ class Disassembly:
                 name = names[0] if names else f"_function_{selector}"
                 self.function_name_to_address[name] = target
                 self.address_to_function_name[target] = name
+                self.function_name_to_hash[name] = selector
 
     # -- queries -------------------------------------------------------------------
     def get_instruction(self, address: int) -> Optional[EvmInstruction]:

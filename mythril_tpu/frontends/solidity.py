@@ -111,6 +111,9 @@ class SolidityContract(EVMContract):
         self.srcmap = decode_srcmap(srcmap_runtime) if srcmap_runtime else []
         self.creation_srcmap = \
             decode_srcmap(srcmap_creation) if srcmap_creation else []
+        #: per-function AST features for the RF tx prioritizer
+        #: (reference soliditycontract.py:195)
+        self.features = None
 
     @classmethod
     def from_standard_json(cls, output: Dict, input_file: str,
@@ -138,11 +141,18 @@ class SolidityContract(EVMContract):
                 creation_code = _strip_unlinked(creation.get("object", ""))
                 if not code:
                     continue
-                yield cls(input_file=input_file, name=name, code=code,
-                          creation_code=creation_code,
-                          srcmap_runtime=runtime.get("sourceMap", ""),
-                          srcmap_creation=creation.get("sourceMap", ""),
-                          sources=sources, source_texts=source_texts)
+                contract = cls(input_file=input_file, name=name, code=code,
+                               creation_code=creation_code,
+                               srcmap_runtime=runtime.get("sourceMap", ""),
+                               srcmap_creation=creation.get("sourceMap", ""),
+                               sources=sources, source_texts=source_texts)
+                ast = output.get("sources", {}).get(path, {}).get("ast")
+                if ast:
+                    from .features import SolidityFeatureExtractor
+
+                    contract.features = \
+                        SolidityFeatureExtractor(ast).extract_features()
+                yield contract
 
     # -- issue source mapping -----------------------------------------------------
     def get_source_info(self, address: int, constructor: bool = False):

@@ -44,6 +44,15 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
     options.add_argument("-b", "--loop-bound", type=int, default=3)
     options.add_argument("--call-depth-limit", type=int, default=3)
     options.add_argument("--pruning-factor", type=float, default=None)
+    options.add_argument("--incremental-txs", default=True,
+                         type=lambda x: str(x).lower() not in ("false", "0"),
+                         help="False = explore RF-prioritized function "
+                              "sequences instead of all orderings")
+    options.add_argument("--enable-state-merging", action="store_true",
+                         help="merge similar world states after each tx")
+    options.add_argument("--enable-summaries", action="store_true",
+                         help="record and replay symbolic transaction "
+                              "summaries instead of re-executing")
     options.add_argument("--unconstrained-storage", action="store_true")
     options.add_argument("--disable-dependency-pruning", action="store_true")
     options.add_argument("--disable-mutation-pruner", action="store_true")
@@ -66,6 +75,9 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
     rpc.add_argument("--rpc", help="custom RPC (host:port, ganache, "
                                    "infura-<net>)")
     rpc.add_argument("--rpctls", action="store_true")
+    rpc.add_argument("--no-onchain-data", action="store_true",
+                     help="do not fault in on-chain storage/balances/code "
+                          "via RPC (on by default when -a/--rpc is given)")
 
 
 def _load_contracts(parser, cli_args, disassembler):
@@ -213,6 +225,13 @@ def main(argv=None) -> int:
                logging.WARNING, logging.INFO,
                logging.DEBUG][min(cli_args.v, 5)],
         format="%(levelname)s:%(name)s: %(message)s")
+
+    # activate third-party plugins published via the mythril_tpu.plugins
+    # entry-point group (reference cli.py boots MythrilPluginLoader the same
+    # way; plugin/discovery.py)
+    from ..plugin import MythrilPluginLoader
+
+    MythrilPluginLoader().load_default_enabled()
 
     if cli_args.command in ("analyze", "a"):
         return _cmd_analyze(parser, cli_args)

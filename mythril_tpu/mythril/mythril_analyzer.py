@@ -29,7 +29,10 @@ class MythrilAnalyzer:
         self.address = address
 
         cmd = cmd_args or _Namespace()
-        self.use_onchain_data = not getattr(cmd, "no_onchain_data", True)
+        # on-chain fault-in defaults ON (reference parity); --no-onchain-data
+        # disables it (ADVICE r2: the old default-True getattr disabled it
+        # permanently because the CLI never defined the flag)
+        self.use_onchain_data = not getattr(cmd, "no_onchain_data", False)
         self.execution_timeout = getattr(cmd, "execution_timeout", 600)
         self.loop_bound = getattr(cmd, "loop_bound", 3)
         self.create_timeout = getattr(cmd, "create_timeout", 10)
@@ -49,6 +52,9 @@ class MythrilAnalyzer:
         args.solver_log = getattr(cmd, "solver_log", None)
         args.transaction_sequences = getattr(cmd, "transaction_sequences",
                                              None)
+        args.incremental_txs = getattr(cmd, "incremental_txs", True)
+        args.enable_state_merging = getattr(cmd, "enable_state_merging", False)
+        args.enable_summaries = getattr(cmd, "enable_summaries", False)
         solver = getattr(cmd, "solver", None)
         if solver:
             args.solver = solver

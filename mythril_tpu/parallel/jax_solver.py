@@ -1,10 +1,11 @@
-"""Batched SAT on device: lockstep DPLL over a dense clause matrix.
+"""Batched SAT on device: lockstep DPLL over a tiled clause matrix.
 
 This is the `--solver jax` backend (SURVEY §7 stage 8). The CNF comes from the
 same Tseitin bit-blaster that feeds the native CDCL core
 (smt/solver/bitblast.py — every gate clause has <= 3 literals, so the dense
 clause matrix is [n_clauses, 3] int32 with 0 padding), and verdicts are
-differentially tested against it.
+differentially tested against it (tests/test_jax_solver.py replays real
+queries captured from analyses through both backends).
 
 Search shape (cube-and-conquer in lockstep): P probe lanes each run complete
 chronological-backtracking DPLL, with their first `log2(P)` decision phases
@@ -12,19 +13,29 @@ forced to the bits of the lane index. Decision-variable selection is a
 deterministic function of the assignment (static frequency order), so the
 forced prefixes form a perfect binary tree of subspaces: UNSAT iff every lane
 proves its cube UNSAT, SAT as soon as one lane completes an assignment —
-sound and complete, and every lane's unit propagation is one dense
-[P, C, 3] gather/compare that maps straight onto the TPU vector units.
+sound and complete.
+
+Unit propagation is tiled: the clause matrix is reshaped to
+[n_tiles, TILE, 3] and scanned tile-by-tile, so device memory per step is
+O(P * TILE) regardless of clause count (a single monolithic [P, C, 3] gather
+killed the TPU worker on realistic bit-blasted queries — a 256-bit multiply
+alone emits ~1e5 clauses). Problems above `clause_cap` return UNKNOWN
+immediately; the caller falls back to the native CDCL core and counts the
+event (SolverStatistics.device_fallbacks) so the fallback is never silent.
+
+Shapes are bucketed to powers of two (variables and clause tiles) and the
+problem tensors are *arguments* of one module-cached jitted runner, so
+successive queries of similar size reuse the compiled executable — path
+constraints grow a conjunct at a time, and per-query recompilation would
+dwarf the solve itself.
 
 Model extraction returns the satisfying lane's assignment, consumed by
 smt/solver/solver.py exactly like a CDCL model.
-
-Termination: a step budget bounds device time; still-searching lanes at the
-budget yield "unknown" and the caller falls back to the native CDCL core.
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache
 from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -34,25 +45,32 @@ SAT, UNSAT, UNKNOWN = 1, 0, -1
 # probe status
 SEARCHING, S_SAT, S_UNSAT = 0, 1, 2
 
+#: clause tile width for the scanned unit-propagation pass
+TILE = 2048
+
+#: default clause cap for device solving: above this the dense DPLL cannot win
+#: against the learning CDCL core anyway, and step time grows linearly with
+#: the tile count — refuse early and let the caller fall back loudly.
+DEFAULT_CLAUSE_CAP = 65_536
+
+#: unassigned / true / false assignment codes
+_UNASSIGNED, _TRUE, _FALSE = 0, 1, 2
+
 
 class _Problem(NamedTuple):
-    lits: "jnp.ndarray"      # int32[C, L] DIMACS literals, 0-padded
-    order: "jnp.ndarray"     # int32[V+1] decision rank per var (lower = earlier)
-    n_vars: int
+    lits: np.ndarray       # int32[n_tiles, TILE, 3] DIMACS literals, 0-padded
+    valid: np.ndarray      # bool[n_tiles, TILE] true where a real clause lives
+    order: np.ndarray      # int32[V1] decision rank per var (lower = earlier)
+    init_assign: np.ndarray  # int8[V1] 0 for real vars, _FALSE for pad vars
+    n_vars: int            # real variable count (pre-padding)
 
 
-class _SolverState(NamedTuple):
-    assign: "jnp.ndarray"     # int8[P, V+1]: 0 unassigned, 1 true, 2 false
-    trail: "jnp.ndarray"      # int32[P, V+1] literals in assignment order
-    tag: "jnp.ndarray"        # int8[P, V+1]: 0 implied, 1 decision, 2 exhausted
-    trail_len: "jnp.ndarray"  # int32[P]
-    status: "jnp.ndarray"     # int8[P]
+def _next_pow2(value: int) -> int:
+    return 1 << max(0, (value - 1)).bit_length()
 
 
 def _build_problem(clauses: List[List[int]], n_vars: int,
                    max_len: int = 3) -> _Problem:
-    import jax.numpy as jnp
-
     long_clauses = [c for c in clauses if len(c) > max_len]
     if long_clauses:
         # split long clauses with fresh connector variables (rare: the blaster
@@ -66,174 +84,218 @@ def _build_problem(clauses: List[List[int]], n_vars: int,
             rebuilt.append(clause)
         clauses = rebuilt
 
-    lits = np.zeros((max(1, len(clauses)), max_len), dtype=np.int32)
+    n_clauses = len(clauses)
+    n_tiles = _next_pow2(max(1, -(-n_clauses // TILE)))
+    lits = np.zeros((n_tiles * TILE, max_len), dtype=np.int32)
     for i, clause in enumerate(clauses):
         lits[i, :len(clause)] = clause
+    valid = np.zeros(n_tiles * TILE, dtype=bool)
+    valid[:n_clauses] = True
 
-    counts = np.zeros(n_vars + 1, dtype=np.int64)
+    # bucket the variable axis; padded vars start pre-assigned (false, not on
+    # the trail) so they are never decided and never block the SAT check
+    v1 = _next_pow2(n_vars + 1)
+    counts = np.zeros(v1, dtype=np.int64)
     for clause in clauses:
         for lit in clause:
             counts[abs(lit)] += 1
-    order = np.zeros(n_vars + 1, dtype=np.int32)
-    by_freq = np.argsort(-counts[1:], kind="stable") + 1
+    order = np.full(v1, 1 << 30, dtype=np.int32)
+    by_freq = np.argsort(-counts[1:n_vars + 1], kind="stable") + 1
     order[by_freq] = np.arange(1, n_vars + 1, dtype=np.int32)
-    order[0] = n_vars + 2  # var 0 never decided
-    return _Problem(jnp.asarray(lits), jnp.asarray(order), n_vars)
+    init_assign = np.zeros(v1, dtype=np.int8)
+    init_assign[n_vars + 1:] = _FALSE
+    return _Problem(lits.reshape(n_tiles, TILE, max_len),
+                    valid.reshape(n_tiles, TILE), order, init_assign, n_vars)
 
 
-def make_stepper(problem: _Problem, forced_depth: int):
-    """Build the jitted single-step transition for this problem."""
+class _SolverState(NamedTuple):
+    assign: "jnp.ndarray"     # int8[P, V1]: 0 unassigned, 1 true, 2 false
+    trail: "jnp.ndarray"      # int32[P, V1] literals in assignment order
+    tag: "jnp.ndarray"        # int8[P, V1]: 0 implied, 1 decision, 2 exhausted
+    trail_len: "jnp.ndarray"  # int32[P]
+    status: "jnp.ndarray"     # int8[P]
+
+
+def _step(state: _SolverState, lits, valid, order, forced_depth: int
+          ) -> _SolverState:
+    """One DPLL transition for every probe lane (pure; traced under jit)."""
     import jax
     import jax.numpy as jnp
 
-    lits, order = problem.lits, problem.order
+    n_probes, v1 = state.assign.shape
+    searching = state.status == SEARCHING
+    probe_idx = jnp.arange(n_probes)[:, None]
 
-    def step(state: _SolverState) -> _SolverState:
-        n_probes, v1 = state.assign.shape
-        searching = state.status == SEARCHING
-        probe_idx = jnp.arange(n_probes)[:, None]
-
-        var = jnp.abs(lits)
-        is_pos = lits > 0
-        is_pad = lits == 0
-        av = state.assign[:, var]
-        val_true = jnp.where(is_pos, av == 1, av == 2) & ~is_pad
-        val_unassigned = (av == 0) & ~is_pad
-        clause_sat = jnp.any(val_true, axis=-1)
+    # ---- tiled unit propagation ------------------------------------------------
+    # Opposite implications of the same variable race benignly: whichever phase
+    # lands, the losing clause becomes falsified and the conflict is detected
+    # on the next step.
+    def tile_body(carry, tile):
+        conflict, implied = carry
+        tile_lits, tile_valid = tile        # [T, 3], [T]
+        var = jnp.abs(tile_lits)
+        is_pos = tile_lits > 0
+        is_pad = tile_lits == 0
+        av = state.assign[:, var]                                # [P, T, 3]
+        val_true = jnp.where(is_pos, av == _TRUE, av == _FALSE) & ~is_pad
+        val_unassigned = (av == _UNASSIGNED) & ~is_pad
+        clause_sat = jnp.any(val_true, axis=-1) | ~tile_valid    # [P, T]
         n_un = jnp.sum(val_unassigned, axis=-1)
-        conflict = jnp.any(~clause_sat & (n_un == 0), axis=-1)
-        unit_clause = ~clause_sat & (n_un == 1)
-        has_units = jnp.any(unit_clause, axis=-1)
-
-        # ---- branch 1: assert all unit literals -------------------------------------
+        conflict = conflict | jnp.any(~clause_sat & (n_un == 0), axis=-1)
+        unit_clause = ~clause_sat & (n_un == 1)                  # [P, T]
         unit_slot = jnp.argmax(val_unassigned, axis=-1)
         unit_lit = jnp.take_along_axis(
-            jnp.broadcast_to(lits, (n_probes,) + lits.shape),
+            jnp.broadcast_to(tile_lits, (n_probes,) + tile_lits.shape),
             unit_slot[..., None], axis=-1)[..., 0]
-        unit_lit = jnp.where(unit_clause, unit_lit, 0)
-        unit_var = jnp.abs(unit_lit)
-        unit_phase = jnp.where(unit_lit > 0, 1, 2).astype(jnp.int8)
-        u_assign = state.assign.at[probe_idx, unit_var].set(
-            jnp.where(unit_clause, unit_phase,
-                      state.assign[probe_idx, unit_var]))
-        u_assign = u_assign.at[:, 0].set(0)
-        newly = (u_assign != state.assign) & (u_assign != 0)
-        new_rank = jnp.cumsum(newly, axis=-1) - 1
-        append_pos = jnp.clip(state.trail_len[:, None] + new_rank, 0, v1 - 1)
-        signed = jnp.where(u_assign == 1, 1, -1) * jnp.arange(v1)
-        u_trail = state.trail.at[probe_idx, append_pos].set(
-            jnp.where(newly, signed.astype(jnp.int32),
-                      state.trail[probe_idx, append_pos]))
-        u_tag = state.tag.at[probe_idx, append_pos].set(
-            jnp.where(newly, jnp.int8(0), state.tag[probe_idx, append_pos]))
-        u_len = state.trail_len + jnp.sum(newly, axis=-1).astype(jnp.int32)
+        # route non-unit rows to a dropped out-of-bounds write
+        unit_var = jnp.where(unit_clause, jnp.abs(unit_lit), v1)
+        unit_phase = jnp.where(unit_lit > 0, _TRUE, _FALSE).astype(jnp.int8)
+        implied = implied.at[probe_idx, unit_var].set(unit_phase, mode="drop")
+        return (conflict, implied), None
 
-        # ---- branch 2: backtrack ----------------------------------------------------
-        pos = jnp.arange(v1)[None, :]
-        in_trail = pos < state.trail_len[:, None]
-        flippable = (state.tag == 1) & in_trail
-        has_flip = jnp.any(flippable, axis=-1)
-        flip_pos = (v1 - 1) - jnp.argmax(flippable[:, ::-1], axis=-1)
-        flip_pos = jnp.where(has_flip, flip_pos, 0).astype(jnp.int32)
-        # unassign everything at positions > flip_pos
-        kill = in_trail & (pos > flip_pos[:, None])
-        kill_var = jnp.abs(state.trail)
-        b_assign = state.assign.at[probe_idx, jnp.where(kill, kill_var, 0)].set(
-            jnp.where(kill, jnp.int8(0),
-                      state.assign[probe_idx, jnp.where(kill, kill_var, 0)]))
-        b_assign = b_assign.at[:, 0].set(0)
-        # flip the decision literal in place, now exhausted
-        flip_lit = jnp.take_along_axis(state.trail, flip_pos[:, None],
-                                       axis=-1)[:, 0]
-        flip_var = jnp.abs(flip_lit)
-        new_phase = jnp.where(flip_lit > 0, 2, 1).astype(jnp.int8)  # opposite
-        b_assign = b_assign.at[jnp.arange(n_probes), flip_var].set(
-            jnp.where(has_flip, new_phase,
-                      b_assign[jnp.arange(n_probes), flip_var]))
-        b_trail = state.trail.at[jnp.arange(n_probes), flip_pos].set(-flip_lit)
-        b_tag = state.tag.at[jnp.arange(n_probes), flip_pos].set(2)
-        b_len = jnp.where(has_flip, flip_pos + 1, state.trail_len)
-        b_status = jnp.where(has_flip, jnp.int8(SEARCHING), jnp.int8(S_UNSAT))
+    init = (jnp.zeros(n_probes, dtype=bool),
+            jnp.zeros((n_probes, v1), dtype=jnp.int8))
+    (conflict, implied), _ = jax.lax.scan(tile_body, init, (lits, valid))
+    implied = implied.at[:, 0].set(0)
+    newly = (implied != 0) & (state.assign == _UNASSIGNED)       # [P, V1]
+    has_units = jnp.any(newly, axis=-1)
 
-        # ---- branch 3: decide -------------------------------------------------------
-        free = state.assign == 0
-        free = free.at[:, 0].set(False)
-        any_free = jnp.any(free, axis=-1)
-        pick_rank = jnp.where(free, order[None, :], jnp.int32(1 << 30))
-        d_var = jnp.argmin(pick_rank, axis=-1).astype(jnp.int32)
-        level = jnp.sum((state.tag >= 1) & in_trail, axis=-1)
-        in_prefix = level < forced_depth
-        probe_bit = (jnp.arange(n_probes) >> jnp.clip(level, 0, 30)) & 1
-        d_phase_true = jnp.where(in_prefix, probe_bit == 1, False)
-        d_assign_val = jnp.where(d_phase_true, jnp.int8(1), jnp.int8(2))
-        d_tag_val = jnp.where(in_prefix, jnp.int8(2), jnp.int8(1))
-        d_lit = jnp.where(d_phase_true, d_var, -d_var)
-        d_assign = state.assign.at[jnp.arange(n_probes), d_var].set(d_assign_val)
-        d_pos = jnp.clip(state.trail_len, 0, v1 - 1)
-        d_trail = state.trail.at[jnp.arange(n_probes), d_pos].set(d_lit)
-        d_tag = state.tag.at[jnp.arange(n_probes), d_pos].set(d_tag_val)
-        d_len = state.trail_len + 1
+    # ---- branch 1: assert all unit literals -------------------------------------
+    u_assign = jnp.where(newly, implied, state.assign)
+    # collision-free trail append: every non-newly column routes to the dropped
+    # out-of-bounds slot v1 instead of aliasing a live position (duplicate-index
+    # scatter order is undefined and implied literals would vanish from the
+    # trail, surviving backtracking — ADVICE r2 high finding)
+    new_rank = jnp.cumsum(newly, axis=-1) - 1
+    append_pos = jnp.where(newly, state.trail_len[:, None] + new_rank, v1)
+    signed = jnp.where(implied == _TRUE, 1, -1) * jnp.arange(v1)
+    u_trail = state.trail.at[probe_idx, append_pos].set(
+        signed.astype(jnp.int32), mode="drop")
+    u_tag = state.tag.at[probe_idx, append_pos].set(jnp.int8(0), mode="drop")
+    u_len = state.trail_len + jnp.sum(newly, axis=-1).astype(jnp.int32)
 
-        # ---- combine: conflict > units > all-assigned(SAT) > decide -----------------
-        take_b = searching & conflict
-        take_u = searching & ~conflict & has_units
-        take_sat = searching & ~conflict & ~has_units & ~any_free
-        take_d = searching & ~conflict & ~has_units & any_free
+    # ---- branch 2: backtrack ----------------------------------------------------
+    pos = jnp.arange(v1)[None, :]
+    in_trail = pos < state.trail_len[:, None]
+    flippable = (state.tag == 1) & in_trail
+    has_flip = jnp.any(flippable, axis=-1)
+    flip_pos = (v1 - 1) - jnp.argmax(flippable[:, ::-1], axis=-1)
+    flip_pos = jnp.where(has_flip, flip_pos, 0).astype(jnp.int32)
+    # unassign everything at positions > flip_pos (collision-free: masked
+    # entries route to the dropped slot, not onto var 0)
+    kill = in_trail & (pos > flip_pos[:, None])
+    kill_var = jnp.where(kill, jnp.abs(state.trail), v1)
+    b_assign = state.assign.at[probe_idx, kill_var].set(
+        jnp.int8(0), mode="drop")
+    # flip the decision literal in place, now exhausted
+    flip_lit = jnp.take_along_axis(state.trail, flip_pos[:, None], axis=-1)[:, 0]
+    flip_var = jnp.abs(flip_lit)
+    new_phase = jnp.where(flip_lit > 0, jnp.int8(_FALSE), jnp.int8(_TRUE))
+    b_assign = b_assign.at[jnp.arange(n_probes), flip_var].set(
+        jnp.where(has_flip, new_phase,
+                  b_assign[jnp.arange(n_probes), flip_var]))
+    b_trail = state.trail.at[jnp.arange(n_probes), flip_pos].set(-flip_lit)
+    b_tag = state.tag.at[jnp.arange(n_probes), flip_pos].set(2)
+    b_len = jnp.where(has_flip, flip_pos + 1, state.trail_len)
+    b_status = jnp.where(has_flip, jnp.int8(SEARCHING), jnp.int8(S_UNSAT))
 
-        def mix(bt, un, de, old):
-            m_b, m_u, m_d = take_b, take_u, take_d
-            while m_b.ndim < bt.ndim:
-                m_b, m_u, m_d = m_b[..., None], m_u[..., None], m_d[..., None]
-            out = jnp.where(m_b, bt, old)
-            out = jnp.where(m_u, un, out)
-            return jnp.where(m_d, de, out)
+    # ---- branch 3: decide -------------------------------------------------------
+    free = state.assign == _UNASSIGNED
+    free = free.at[:, 0].set(False)
+    any_free = jnp.any(free, axis=-1)
+    pick_rank = jnp.where(free, order[None, :], jnp.int32(1 << 30))
+    d_var = jnp.argmin(pick_rank, axis=-1).astype(jnp.int32)
+    level = jnp.sum((state.tag >= 1) & in_trail, axis=-1)
+    in_prefix = level < forced_depth
+    probe_bit = (jnp.arange(n_probes) >> jnp.clip(level, 0, 30)) & 1
+    d_phase_true = jnp.where(in_prefix, probe_bit == 1, False)
+    d_assign_val = jnp.where(d_phase_true, jnp.int8(_TRUE), jnp.int8(_FALSE))
+    d_tag_val = jnp.where(in_prefix, jnp.int8(2), jnp.int8(1))
+    d_lit = jnp.where(d_phase_true, d_var, -d_var)
+    d_assign = state.assign.at[jnp.arange(n_probes), d_var].set(d_assign_val)
+    d_pos = jnp.clip(state.trail_len, 0, v1 - 1)
+    d_trail = state.trail.at[jnp.arange(n_probes), d_pos].set(d_lit)
+    d_tag = state.tag.at[jnp.arange(n_probes), d_pos].set(d_tag_val)
+    d_len = state.trail_len + 1
 
-        assign = mix(b_assign, u_assign, d_assign, state.assign)
-        trail = mix(b_trail, u_trail, d_trail, state.trail)
-        tag = mix(b_tag, u_tag, d_tag, state.tag)
-        trail_len = mix(b_len, u_len, d_len, state.trail_len)
-        status = jnp.where(take_b, b_status, state.status)
-        status = jnp.where(take_sat, jnp.int8(S_SAT), status)
-        return _SolverState(assign, trail, tag, trail_len, status)
+    # ---- combine: conflict > units > all-assigned(SAT) > decide -----------------
+    take_b = searching & conflict
+    take_u = searching & ~conflict & has_units
+    take_sat = searching & ~conflict & ~has_units & ~any_free
+    take_d = searching & ~conflict & ~has_units & any_free
 
-    return step
+    def mix(bt, un, de, old):
+        m_b, m_u, m_d = take_b, take_u, take_d
+        while m_b.ndim < bt.ndim:
+            m_b, m_u, m_d = m_b[..., None], m_u[..., None], m_d[..., None]
+        out = jnp.where(m_b, bt, old)
+        out = jnp.where(m_u, un, out)
+        return jnp.where(m_d, de, out)
+
+    assign = mix(b_assign, u_assign, d_assign, state.assign)
+    trail = mix(b_trail, u_trail, d_trail, state.trail)
+    tag = mix(b_tag, u_tag, d_tag, state.tag)
+    trail_len = mix(b_len, u_len, d_len, state.trail_len)
+    status = jnp.where(take_b, b_status, state.status)
+    status = jnp.where(take_sat, jnp.int8(S_SAT), status)
+    return _SolverState(assign, trail, tag, trail_len, status)
+
+
+@lru_cache(maxsize=64)
+def _get_runner(chunk: int, forced_depth: int):
+    """One compiled executable per (chunk, forced_depth); problem tensors are
+    arguments, so every query in the same shape bucket reuses it."""
+    import jax
+
+    def run(state, lits, valid, order):
+        return jax.lax.fori_loop(
+            0, chunk,
+            lambda _, st: _step(st, lits, valid, order, forced_depth), state)
+
+    return jax.jit(run)
 
 
 def solve_cnf_device(clauses: List[List[int]], n_vars: int,
                      n_probes: int = 32, max_steps: int = 20_000,
-                     chunk: int = 256
+                     chunk: int = 256, clause_cap: int = DEFAULT_CLAUSE_CAP
                      ) -> Tuple[int, Optional[List[bool]]]:
     """Solve CNF on the JAX backend. Same contract as sat.solve_cnf:
-    (status, model) with model[v-1] the value of DIMACS var v."""
-    import jax
+    (status, model) with model[v-1] the value of DIMACS var v.
+
+    Returns UNKNOWN (never raises, never guesses) when the problem exceeds
+    `clause_cap` — the caller falls back to the native CDCL core."""
     import jax.numpy as jnp
 
+    if not clauses:
+        # trivially satisfiable — padding with a zero row would fabricate an
+        # empty (always-false) clause (ADVICE r2 medium finding)
+        return SAT, [False] * n_vars
     for clause in clauses:
         if not clause:
             return UNSAT, None
+    if len(clauses) > clause_cap:
+        return UNKNOWN, None
 
     problem = _build_problem(clauses, n_vars)
-    n_vars = problem.n_vars
     forced_depth = max(0, int(np.log2(max(1, n_probes))))
-    step = make_stepper(problem, forced_depth)
+    runner = _get_runner(chunk, forced_depth)
 
-    v1 = n_vars + 1
+    v1 = problem.order.shape[0]
+    lits = jnp.asarray(problem.lits)
+    valid = jnp.asarray(problem.valid)
+    order = jnp.asarray(problem.order)
     state = _SolverState(
-        assign=jnp.zeros((n_probes, v1), dtype=jnp.int8),
+        assign=jnp.broadcast_to(jnp.asarray(problem.init_assign),
+                                (n_probes, v1)),
         trail=jnp.zeros((n_probes, v1), dtype=jnp.int32),
         tag=jnp.zeros((n_probes, v1), dtype=jnp.int8),
         trail_len=jnp.zeros(n_probes, dtype=jnp.int32),
         status=jnp.zeros(n_probes, dtype=jnp.int8),
     )
 
-    @partial(jax.jit, static_argnames=("n",))
-    def run_chunk(s, n):
-        return jax.lax.fori_loop(
-            0, n, lambda _, st: step(st), s)
-
     steps = 0
     while steps < max_steps:
-        state = run_chunk(state, chunk)
+        state = runner(state, lits, valid, order)
         steps += chunk
         status = np.asarray(state.status)
         if (status == S_SAT).any() or (status != SEARCHING).all():
@@ -243,7 +305,8 @@ def solve_cnf_device(clauses: List[List[int]], n_vars: int,
     sat_lanes = np.nonzero(status == S_SAT)[0]
     if len(sat_lanes):
         assign = np.asarray(state.assign[int(sat_lanes[0])])
-        return SAT, [bool(assign[v] == 1) for v in range(1, n_vars + 1)]
+        return SAT, [bool(assign[v] == _TRUE)
+                     for v in range(1, problem.n_vars + 1)]
     if (status == S_UNSAT).all():
         return UNSAT, None
     return UNKNOWN, None

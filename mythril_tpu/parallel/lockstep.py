@@ -110,17 +110,22 @@ def _mem_read(memory, msize, offset, nbytes_static):
 
 
 def _mem_write(memory, lane_mask, offset, data, size=None):
-    """Masked scatter of data[B, n] to memory[lane, offset:offset+n]."""
+    """Masked scatter of data[B, n] to memory[lane, offset:offset+n].
+
+    Masked-out or out-of-capacity bytes route to a dropped out-of-bounds
+    write — clipping them onto live cells made the stale write collide with
+    the final data byte when a copy ended exactly at capacity, and
+    duplicate-index scatter order is undefined on TPU (ADVICE r2 medium)."""
     m = memory.shape[1]
     n = data.shape[1]
     j = jnp.arange(n)
-    idx = jnp.clip(offset[:, None] + j, 0, m - 1).astype(I32)
-    current = jnp.take_along_axis(memory, idx, axis=1)
-    write = lane_mask[:, None]
+    idx = offset[:, None] + j
+    write = lane_mask[:, None] & (idx >= 0) & (idx < m)
     if size is not None:
         write = write & (j < size[:, None])
-    vals = jnp.where(write, data, current)
-    return jnp.put_along_axis(memory, idx, vals, axis=1, inplace=False)
+    rows = jnp.arange(memory.shape[0])[:, None]
+    scatter_idx = jnp.where(write, idx, m).astype(I32)
+    return memory.at[rows, scatter_idx].set(data, mode="drop")
 
 
 def _table_get(keys, vals, used, key):
@@ -598,12 +603,20 @@ def step_many(state: StateBatch, n_steps: int) -> StateBatch:
 
 
 def run(state: StateBatch, max_steps: int = 100_000,
-        chunk: int = 64) -> StateBatch:
-    """Host driver: step in fused chunks until every lane halted (or budget)."""
+        chunk: int = 64, escape_on_budget: bool = True) -> StateBatch:
+    """Host driver: step in fused chunks until every lane halted (or budget).
+
+    Lanes still RUNNING when the step budget runs out are marked ESCAPED so the
+    host oracle finishes them — `run` never returns RUNNING lanes (the
+    `foreverOutOfGas` VMTests loop for ~9k iterations before OOG; burning
+    device steps on them starves the rest of the batch)."""
     steps = 0
     while steps < max_steps:
         state = step_many(state, chunk)
         steps += chunk
         if not bool(jnp.any(state.status == RUNNING)):
             break
+    if escape_on_budget:
+        state = state._replace(status=jnp.where(state.status == RUNNING,
+                                                ESCAPED, state.status))
     return state

@@ -1,0 +1,6 @@
+from .discovery import PluginDiscovery
+from .interface import MythrilPlugin, MythrilLaserPlugin
+from .loader import MythrilPluginLoader, UnsupportedPluginType
+
+__all__ = ["PluginDiscovery", "MythrilPlugin", "MythrilLaserPlugin",
+           "MythrilPluginLoader", "UnsupportedPluginType"]

@@ -240,6 +240,14 @@ def If(cond, then, otherwise):
         annotations |= then.annotations
     if isinstance(otherwise, Expression):
         annotations |= otherwise.annotations
+    # Array-valued If (state-merge: merged storage = If(c, s1, s2))
+    for branch in (then, otherwise):
+        if isinstance(branch, Expression) and isinstance(branch.raw.sort,
+                                                         terms.ArraySort):
+            from .array import BaseArray
+
+            return BaseArray(terms.ite(cond.raw, then.raw, otherwise.raw),
+                             annotations)
     width = None
     for branch in (then, otherwise):
         if isinstance(branch, BitVec):

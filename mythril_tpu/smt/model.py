@@ -26,7 +26,13 @@ class Model:
 
     def merge(self, other: "Model") -> "Model":
         merged = Model(self.assignment, self.arrays, self.ufs)
-        merged.assignment.update(other.assignment)
+        # explicit key loop: lazy assignments (incremental._BitsAssignment)
+        # expose their full domain via keys(), which dict.update would bypass
+        for key in list(other.assignment.keys()):
+            try:
+                merged.assignment[key] = other.assignment[key]
+            except KeyError:
+                continue
         for base, table in other.arrays.items():
             merged.arrays.setdefault(base, {}).update(table)
         merged.ufs.update(other.ufs)

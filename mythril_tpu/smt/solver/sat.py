@@ -19,18 +19,133 @@ def _load_lib():
     if _lib_checked:
         return _lib
     _lib_checked = True
-    path = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", "..",
-                                        "native", "build", "libmythril_native.so"))
+    native_dir = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..",
+                                              "..", "native"))
+    path = os.path.join(native_dir, "build", "libmythril_native.so")
+    import logging
+
+    log = logging.getLogger(__name__)
+    if not os.path.exists(path) and os.path.exists(
+            os.path.join(native_dir, "build.sh")):
+        # fresh checkout: build the native core once (the Python DPLL fallback
+        # is orders of magnitude too slow for real queries). A lock file makes
+        # concurrent first-use (pytest-xdist, parallel analyzer runs) safe:
+        # one process builds, the rest wait and dlopen the finished artifact.
+        import subprocess
+
+        log.info("building native CDCL core (first run; ~seconds): %s",
+                 os.path.join(native_dir, "build.sh"))
+        os.makedirs(os.path.join(native_dir, "build"), exist_ok=True)
+        lock_path = os.path.join(native_dir, "build", ".build.lock")
+        try:
+            with open(lock_path, "w") as lock_handle:
+                try:
+                    import fcntl
+
+                    fcntl.flock(lock_handle, fcntl.LOCK_EX)
+                except ImportError:
+                    pass  # non-POSIX: accept the small race
+                if not os.path.exists(path):  # may have been built while waiting
+                    subprocess.run(["sh", "build.sh"], cwd=native_dir,
+                                   check=True, capture_output=True,
+                                   timeout=120)
+        except (subprocess.SubprocessError, OSError) as error:
+            log.warning(
+                "native CDCL build failed (%s); falling back to the pure-"
+                "Python DPLL, which is orders of magnitude slower — run "
+                "native/build.sh manually to fix", error)
     if os.path.exists(path):
         try:
             lib = ctypes.CDLL(path)
             lib.mtpu_solve.argtypes = [ctypes.POINTER(ctypes.c_int32), ctypes.c_size_t,
                                        ctypes.c_int32, ctypes.c_int64, ctypes.c_char_p]
             lib.mtpu_solve.restype = ctypes.c_int
+            lib.mtpu_session_new.argtypes = []
+            lib.mtpu_session_new.restype = ctypes.c_void_p
+            lib.mtpu_session_free.argtypes = [ctypes.c_void_p]
+            lib.mtpu_session_free.restype = None
+            lib.mtpu_session_add.argtypes = [ctypes.c_void_p,
+                                             ctypes.POINTER(ctypes.c_int32),
+                                             ctypes.c_size_t, ctypes.c_int32]
+            lib.mtpu_session_add.restype = ctypes.c_int
+            lib.mtpu_session_solve.argtypes = [ctypes.c_void_p,
+                                               ctypes.POINTER(ctypes.c_int32),
+                                               ctypes.c_size_t, ctypes.c_int64,
+                                               ctypes.c_char_p, ctypes.c_int32]
+            lib.mtpu_session_solve.restype = ctypes.c_int
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError) as error:
+            log.warning(
+                "could not load native CDCL library %s (%s); using the pure-"
+                "Python DPLL fallback (orders of magnitude slower)", path,
+                error)
             _lib = None
     return _lib
+
+
+def have_native() -> bool:
+    return _load_lib() is not None
+
+
+def _flatten(clauses: List[List[int]]):
+    total = sum(len(c) + 1 for c in clauses)
+    flat = (ctypes.c_int32 * max(1, total))()
+    pos = 0
+    for clause in clauses:
+        for lit in clause:
+            flat[pos] = lit
+            pos += 1
+        flat[pos] = 0
+        pos += 1
+    return flat, total
+
+
+class Session:
+    """Long-lived native CDCL fed a monotone clause pool and queried under
+    assumption literals; learned clauses / activities / phases persist across
+    queries (the z3-incrementality equivalent, reference support/model.py:69)."""
+
+    def __init__(self):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("native CDCL library unavailable")
+        self._lib = lib
+        self._handle = lib.mtpu_session_new()
+        self.broken = False
+
+    def add_clauses(self, clauses: List[List[int]], max_var: int) -> bool:
+        if self.broken or not clauses:
+            return not self.broken
+        flat, total = _flatten(clauses)
+        ok = self._lib.mtpu_session_add(self._handle, flat, total, max_var)
+        if not ok:
+            self.broken = True
+        return not self.broken
+
+    def solve(self, assumptions: List[int], n_vars: int,
+              max_conflicts: int = 2_000_000
+              ) -> Tuple[int, Optional[List[bool]]]:
+        if self.broken:
+            return UNSAT, None
+        assume = (ctypes.c_int32 * max(1, len(assumptions)))(*assumptions)
+        model_buf = ctypes.create_string_buffer(max(1, n_vars))
+        status = self._lib.mtpu_session_solve(
+            self._handle, assume, len(assumptions), max_conflicts,
+            model_buf, n_vars)
+        if status == SAT:
+            return SAT, [model_buf.raw[v] == 1 for v in range(n_vars)]
+        return status, None
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.mtpu_session_free(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def solve_cnf(clauses: List[List[int]], n_vars: int,
@@ -38,15 +153,7 @@ def solve_cnf(clauses: List[List[int]], n_vars: int,
     """Returns (status, model). model[v-1] is the boolean for DIMACS var v on SAT."""
     lib = _load_lib()
     if lib is not None:
-        total = sum(len(c) + 1 for c in clauses)
-        flat = (ctypes.c_int32 * total)()
-        pos = 0
-        for clause in clauses:
-            for lit in clause:
-                flat[pos] = lit
-                pos += 1
-            flat[pos] = 0
-            pos += 1
+        flat, total = _flatten(clauses)
         model_buf = ctypes.create_string_buffer(max(1, n_vars))
         status = lib.mtpu_solve(flat, total, n_vars, max_conflicts, model_buf)
         if status == SAT:

@@ -18,15 +18,23 @@ class SolverStatistics:
             cls._instance.enabled = False
             cls._instance.query_count = 0
             cls._instance.solver_time = 0.0
+            cls._instance.device_queries = 0
+            cls._instance.device_fallbacks = 0
         return cls._instance
 
     def reset(self) -> None:
         self.query_count = 0
         self.solver_time = 0.0
+        self.device_queries = 0
+        self.device_fallbacks = 0
 
     def __repr__(self):
-        return (f"Solver statistics: query count: {self.query_count}, "
-                f"solver time: {self.solver_time:.3f}s")
+        out = (f"Solver statistics: query count: {self.query_count}, "
+               f"solver time: {self.solver_time:.3f}s")
+        if self.device_queries:
+            out += (f", device queries: {self.device_queries}"
+                    f" (fallbacks to CDCL: {self.device_fallbacks})")
+        return out
 
 
 def stat_smt_query(func):

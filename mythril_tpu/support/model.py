@@ -147,6 +147,8 @@ def _dump_query(constraints) -> None:
 
     os.makedirs(args.solver_log, exist_ok=True)
     _query_counter[0] += 1
-    path = os.path.join(args.solver_log, f"{_query_counter[0]}.smt2")
+    # pid-namespaced so successive runs into one directory never overwrite
+    path = os.path.join(args.solver_log,
+                        f"{os.getpid()}-{_query_counter[0]}.smt2")
     with open(path, "w") as handle:
         handle.write(to_smt2([c.raw for c in constraints]))

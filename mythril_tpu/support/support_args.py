@@ -38,6 +38,8 @@ class Args:
         #: solver backend: "cdcl" (native host solver) or "jax" (batched TPU solver)
         self.solver = "cdcl"
         self.sparse_pruning = True
+        self.enable_state_merging = False
+        self.enable_summaries = False
 
 
 args = Args()

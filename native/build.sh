@@ -1,8 +1,11 @@
 #!/bin/sh
 # Build the native core (keccak + CDCL SAT solver) into one shared library.
 # Pure-Python fallbacks exist for every symbol here; the framework works unbuilt.
+# Build lands in a temp file first and is renamed atomically so a concurrent
+# dlopen can never see a half-written artifact.
 set -e
 cd "$(dirname "$0")"
 mkdir -p build
-g++ -O2 -fPIC -shared -std=c++17 -o build/libmythril_native.so keccak.cpp cdcl.cpp
+g++ -O2 -fPIC -shared -std=c++17 -o "build/.libmythril_native.so.$$" keccak.cpp cdcl.cpp
+mv "build/.libmythril_native.so.$$" build/libmythril_native.so
 echo "built native/build/libmythril_native.so"

@@ -33,51 +33,69 @@ struct Clause {
 
 class Solver {
  public:
-  explicit Solver(int n_vars)
-      : n_vars_(n_vars),
-        assign_(n_vars, kUndef),
-        phase_(n_vars, 0),
-        level_(n_vars, 0),
-        reason_(n_vars, -1),
-        activity_(n_vars, 0.0),
-        watches_(2 * n_vars),
-        seen_(n_vars, 0),
-        heap_pos_(n_vars, -1) {
-    for (int v = 0; v < n_vars_; ++v) insert_heap(v);
+  explicit Solver(int n_vars) { ensure_vars(n_vars); }
+
+  // grow all per-variable structures (incremental sessions add variables as
+  // the bit-blaster's monotone clause pool grows)
+  void ensure_vars(int n_vars) {
+    if (n_vars <= n_vars_) return;
+    assign_.resize(n_vars, kUndef);
+    phase_.resize(n_vars, 0);
+    level_.resize(n_vars, 0);
+    reason_.resize(n_vars, -1);
+    activity_.resize(n_vars, 0.0);
+    watches_.resize(2 * n_vars);
+    seen_.resize(n_vars, 0);
+    heap_pos_.resize(n_vars, -1);
+    for (int v = n_vars_; v < n_vars; ++v) insert_heap(v);
+    n_vars_ = n_vars;
   }
 
   bool add_clause(std::vector<Lit> lits) {
+    if (broken_) return false;
+    cancel_until(0);
     std::sort(lits.begin(), lits.end());
     lits.erase(std::unique(lits.begin(), lits.end()), lits.end());
     for (size_t i = 0; i + 1 < lits.size(); ++i)
       if (lits[i] == lit_not(lits[i + 1])) return true;  // tautology
-    if (lits.empty()) return false;  // empty clause: trivially UNSAT
+    if (lits.empty()) { broken_ = true; return false; }
     if (lits.size() == 1) {
-      if (value(lits[0]) == kFalse) return false;
+      if (value(lits[0]) == kFalse) { broken_ = true; return false; }
       if (value(lits[0]) == kUndef) enqueue(lits[0], -1);
       return true;
     }
+    // watches must not start on level-0-false literals in an incremental
+    // session: move two non-false literals (or a true one) to the front
+    size_t front = 0;
+    for (size_t k = 0; k < lits.size() && front < 2; ++k)
+      if (value(lits[k]) != kFalse) std::swap(lits[front++], lits[k]);
+    if (front == 0) { broken_ = true; return false; }  // all false at level 0
+    if (front == 1 && value(lits[0]) == kUndef) enqueue(lits[0], -1);
     clauses_.push_back({std::move(lits), 0.0, false});
     attach(static_cast<int>(clauses_.size()) - 1);
     return true;
   }
 
-  // 1 SAT, 0 UNSAT, -1 budget exceeded
-  int solve(int64_t max_conflicts) {
-    if (propagate() != -1) return 0;  // top-level conflict
+  // 1 SAT, 0 UNSAT (under assumptions), -1 budget exceeded
+  int solve(int64_t max_conflicts, const std::vector<Lit>& assumptions = {}) {
+    if (broken_) return 0;
+    cancel_until(0);
+    if (propagate() != -1) { broken_ = true; return 0; }  // top-level conflict
     int64_t conflicts = 0;
     int64_t restart_limit = luby(restart_count_) * 128;
-    int64_t reduce_limit = 4000;
+    int64_t reduce_limit = 4000 + static_cast<int64_t>(num_learned_);
     for (;;) {
       int confl = propagate();
       if (confl != -1) {
         ++conflicts;
-        if (decision_level() == 0) return 0;
+        if (decision_level() == 0) { broken_ = true; return 0; }
+        if (decision_level() <= static_cast<int>(assumptions.size()))
+          return 0;  // conflict forced by the assumption prefix alone
         std::vector<Lit> learnt;
         int backtrack_level;
         analyze(confl, learnt, backtrack_level);
         cancel_until(backtrack_level);
-        if (learnt.size() == 1) {
+        if (learnt.size() == 1 && backtrack_level == 0) {
           enqueue(learnt[0], -1);
         } else {
           clauses_.push_back({learnt, clause_inc_, true});
@@ -96,6 +114,12 @@ class Solver {
           reduce_learned();
           reduce_limit += 1000;
         }
+      } else if (decision_level() < static_cast<int>(assumptions.size())) {
+        // assumption prefix: one decision level per assumption literal
+        Lit a = assumptions[decision_level()];
+        if (value(a) == kFalse) return 0;  // UNSAT under assumptions
+        new_decision_level();
+        if (value(a) == kUndef) enqueue(a, -1);
       } else {
         int next = pick_branch_var();
         if (next == -1) return 1;  // all assigned: SAT
@@ -106,6 +130,7 @@ class Solver {
   }
 
   LBool model(int var) const { return assign_[var]; }
+  int n_vars() const { return n_vars_; }
 
  private:
   LBool value(Lit l) const {
@@ -349,7 +374,8 @@ class Solver {
     return luby(i - (1LL << (k - 1)) + 1);
   }
 
-  int n_vars_;
+  int n_vars_ = 0;
+  bool broken_ = false;  // pool unsatisfiable at level 0: every query UNSAT
   std::vector<Clause> clauses_;
   std::vector<LBool> assign_;
   std::vector<uint8_t> phase_;
@@ -371,15 +397,12 @@ class Solver {
 
 }  // namespace
 
-extern "C" int mtpu_solve(const int32_t* lits, size_t n_lits, int32_t n_vars,
-                          int64_t max_conflicts, uint8_t* model_out) {
-  Solver solver(n_vars);
+static bool feed_clauses(Solver& solver, const int32_t* lits, size_t n_lits) {
   std::vector<Lit> clause;
-  bool ok = true;
   for (size_t i = 0; i < n_lits; ++i) {
     int32_t l = lits[i];
     if (l == 0) {
-      if (!solver.add_clause(clause)) { ok = false; break; }
+      if (!solver.add_clause(clause)) return false;
       clause.clear();
     } else {
       int var = std::abs(l) - 1;
@@ -387,12 +410,62 @@ extern "C" int mtpu_solve(const int32_t* lits, size_t n_lits, int32_t n_vars,
     }
   }
   // flush a trailing clause missing its 0 terminator rather than dropping it
-  if (ok && !clause.empty()) ok = solver.add_clause(clause);
-  if (!ok) return 0;
+  if (!clause.empty()) return solver.add_clause(clause);
+  return true;
+}
+
+extern "C" int mtpu_solve(const int32_t* lits, size_t n_lits, int32_t n_vars,
+                          int64_t max_conflicts, uint8_t* model_out) {
+  Solver solver(n_vars);
+  if (!feed_clauses(solver, lits, n_lits)) return 0;
   int result = solver.solve(max_conflicts);
   if (result == 1 && model_out) {
     for (int v = 0; v < n_vars; ++v)
       model_out[v] = solver.model(v) == kTrue ? 1 : 0;
+  }
+  return result;
+}
+
+// ---------------------------------------------------------------------------
+// Incremental session API: a long-lived solver fed a monotone clause pool
+// (the bit-blaster's structurally-hashed gate definitions), queried under
+// assumption literals (the Tseitin roots of each path-constraint set).
+// Learned clauses, VSIDS activities and saved phases persist across queries —
+// the z3-incrementality equivalent the reference leans on
+// (mythril/support/model.py:69, z3 Solver reuse).
+// ---------------------------------------------------------------------------
+
+extern "C" void* mtpu_session_new() { return new Solver(0); }
+
+extern "C" void mtpu_session_free(void* handle) {
+  delete static_cast<Solver*>(handle);
+}
+
+// returns 0 if the pool became unsatisfiable at level 0, else 1
+extern "C" int mtpu_session_add(void* handle, const int32_t* lits,
+                                size_t n_lits, int32_t max_var) {
+  Solver* solver = static_cast<Solver*>(handle);
+  solver->ensure_vars(max_var);
+  return feed_clauses(*solver, lits, n_lits) ? 1 : 0;
+}
+
+// 1 SAT, 0 UNSAT under assumptions, -1 budget exceeded.
+// On SAT, model_out[v-1] holds 0/1 for vars 1..n_vars.
+extern "C" int mtpu_session_solve(void* handle, const int32_t* assumptions,
+                                  size_t n_assumptions, int64_t max_conflicts,
+                                  uint8_t* model_out, int32_t n_vars) {
+  Solver* solver = static_cast<Solver*>(handle);
+  solver->ensure_vars(n_vars);
+  std::vector<Lit> assume;
+  assume.reserve(n_assumptions);
+  for (size_t i = 0; i < n_assumptions; ++i) {
+    int32_t l = assumptions[i];
+    assume.push_back(mk_lit(std::abs(l) - 1, l < 0));
+  }
+  int result = solver->solve(max_conflicts, assume);
+  if (result == 1 && model_out) {
+    for (int v = 0; v < n_vars; ++v)
+      model_out[v] = solver->model(v) == kTrue ? 1 : 0;
   }
   return result;
 }

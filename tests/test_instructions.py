@@ -147,6 +147,26 @@ def test_transient_storage():
     assert state.mstate.stack[-1].value == 77
 
 
+def test_call_to_cheat_address_succeeds():
+    """hevm/forge cheat-code address is modeled as unconditional success
+    (core/cheat_code.py) so foundry test scaffolding never blocks analysis."""
+    from mythril_tpu.core.cheat_code import hevm_cheat_code
+
+    state = make_state()
+    # CALL args (pushed in reverse): retSize, retOff, argSize, argOff, value,
+    # to, gas
+    push(state, 0, 0, 0, 0, 0, hevm_cheat_code.address, 50000)
+    successors = run(state, "CALL")
+    assert len(successors) == 1
+    retval = successors[0].mstate.stack[-1]
+    constraints = successors[0].world_state.constraints
+    assert any(c.raw.op == "eq"
+               and retval.raw in c.raw.args
+               and any(a.is_const and a.value == 1 for a in c.raw.args)
+               for c in constraints), "retval must be pinned to success"
+    assert not retval.raw.is_const  # symbolic retval constrained, not literal
+
+
 def test_jumpi_forks_two_ways():
     # code: PUSH1 01 PUSH1 06 JUMPI STOP JUMPDEST STOP -> JUMPDEST at byte 6
     state = make_state("0x6001600657005b00")

@@ -67,6 +67,81 @@ def test_long_clauses_split():
     assert status == jax_solver.UNSAT
 
 
+def test_implication_chain_backtracking_regression():
+    """ADVICE r2 high: duplicate-index trail scatter dropped implied literals,
+    so stale assignments survived backtracking and this SAT instance was
+    reported UNSAT by the device solver."""
+    clauses = [[1, 2], [1, -2, 3], [-3, -2, 1], [-2, -1], [4, 1, 2]]
+    ref_status, _ = sat.solve_cnf(clauses, 4)
+    assert ref_status == sat.SAT
+    status, model = jax_solver.solve_cnf_device(clauses, 4, n_probes=1)
+    assert status == jax_solver.SAT
+    _check_model(clauses, model)
+
+
+def test_empty_cnf_is_sat():
+    """ADVICE r2 medium: the zero-row padding used to act as an empty
+    (always-false) clause, reporting UNSAT for a trivially-true problem."""
+    status, model = jax_solver.solve_cnf_device([], 3)
+    assert status == jax_solver.SAT
+    assert model == [False, False, False]
+
+
+def test_empty_clause_is_unsat():
+    status, _ = jax_solver.solve_cnf_device([[1], []], 1)
+    assert status == jax_solver.UNSAT
+
+
+def test_clause_cap_returns_unknown():
+    """Problems above the device clause cap must refuse (UNKNOWN), never
+    crash or guess — the solver seam then falls back to CDCL loudly."""
+    clauses = [[1, 2], [-1, 2]] * 40
+    status, _ = jax_solver.solve_cnf_device(clauses, 2, clause_cap=10)
+    assert status == jax_solver.UNKNOWN
+
+
+def test_device_failure_falls_back_to_cdcl(monkeypatch):
+    """VERDICT r2 weak #1: a TPU-side failure silently produced a clean
+    report. The seam must catch, count, and re-solve on the CDCL core."""
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+
+    def boom(*a, **k):
+        raise RuntimeError("TPU worker process crashed")
+
+    monkeypatch.setattr(jax_solver, "solve_cnf_device", boom)
+    stats = SolverStatistics()
+    before = stats.device_fallbacks
+    a = symbol_factory.BitVecSym("fb", 32)
+    args.solver = "jax"
+    try:
+        solver = Solver(timeout=20_000)
+        solver.add(a == 5)
+        assert solver.check() == "sat"
+        assert solver.model().eval((a == 5).raw)
+    finally:
+        args.solver = "cdcl"
+    assert stats.device_fallbacks == before + 1
+
+
+def test_realistic_multiply_query_no_crash():
+    """The r2 crash repro: a 256-bit multiply bit-blasts to ~1e5 clauses; the
+    monolithic gather killed the TPU worker. Now the cap routes it to CDCL
+    and the verdict/model must still be correct under --solver jax."""
+    x = symbol_factory.BitVecSym("mulx", 256)
+    y = symbol_factory.BitVecSym("muly", 256)
+    args.solver = "jax"
+    try:
+        solver = Solver(timeout=60_000)
+        solver.add(x * y == 12, x > 1, y > 1)
+        assert solver.check() == "sat"
+        model = solver.model()
+        xv = model.eval(x.raw)
+        yv = model.eval(y.raw)
+        assert (xv * yv) % (1 << 256) == 12
+    finally:
+        args.solver = "cdcl"
+
+
 def test_pipeline_with_jax_backend():
     """Full QF_BV queries through Solver with --solver jax."""
     a = symbol_factory.BitVecSym("a", 32)

@@ -116,6 +116,30 @@ def corpus_result():
     return usable, state
 
 
+def test_mem_write_capacity_boundary():
+    """ADVICE r2 medium: a masked copy ending exactly at mem capacity used to
+    clip its masked-out bytes onto mem_cap-1, and the duplicate-index scatter
+    could silently revert the final data byte."""
+    import jax.numpy as jnp
+
+    memory = jnp.full((2, 8), 0xAA, dtype=jnp.uint8)
+    data = jnp.tile(jnp.arange(1, 5, dtype=jnp.uint8), (2, 1))
+    out = lockstep._mem_write(
+        memory, jnp.array([True, True]), jnp.array([4, 6]), data,
+        size=jnp.array([4, 4]))
+    got = np.asarray(out)
+    # lane 0: copy of 4 bytes ends exactly at capacity — all bytes land
+    assert got[0].tolist() == [0xAA] * 4 + [1, 2, 3, 4]
+    # lane 1: bytes past capacity are dropped, in-range bytes land
+    assert got[1].tolist() == [0xAA] * 6 + [1, 2]
+    # masked-off lane writes nothing
+    out2 = lockstep._mem_write(
+        memory, jnp.array([False, True]), jnp.array([0, 0]), data)
+    got2 = np.asarray(out2)
+    assert got2[0].tolist() == [0xAA] * 8
+    assert got2[1].tolist() == [1, 2, 3, 4, 0xAA, 0xAA, 0xAA, 0xAA]
+
+
 def test_corpus_coverage(corpus_result):
     """The lockstep engine must genuinely execute most of the corpus on device
     (escaping everything would vacuously pass the storage checks)."""

@@ -1,0 +1,87 @@
+"""Extension discovery tests (capability parity: reference
+mythril/plugin/discovery.py + loader.py — third-party detector/plugin
+packages via entry points)."""
+
+import pytest
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.module.loader import ModuleLoader
+from mythril_tpu.core.plugin.interface import LaserPlugin
+from mythril_tpu.core.plugin.loader import LaserPluginLoader
+from mythril_tpu.plugin import (MythrilLaserPlugin, MythrilPlugin,
+                                MythrilPluginLoader, PluginDiscovery,
+                                UnsupportedPluginType)
+
+
+class FakeDetector(DetectionModule, MythrilPlugin):
+    name = "fake-detector"
+    swc_id = "000"
+    description = "test detector"
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["STOP"]
+    plugin_default_enabled = True
+
+    def _execute(self, state):
+        return []
+
+
+class FakeLaserPlugin(MythrilLaserPlugin):
+    name = "fake-laser-plugin"
+    plugin_default_enabled = True
+
+    def __call__(self, *args, **kwargs):
+        class _Plugin(LaserPlugin):
+            def initialize(self, symbolic_vm):
+                pass
+
+        return _Plugin()
+
+
+@pytest.fixture
+def discovery(monkeypatch):
+    instance = PluginDiscovery()
+    monkeypatch.setattr(instance, "_installed_plugins",
+                        {"fake-detector": FakeDetector,
+                         "fake-laser-plugin": FakeLaserPlugin})
+    return instance
+
+
+def test_discovery_listing(discovery):
+    assert discovery.is_installed("fake-detector")
+    assert not discovery.is_installed("nope")
+    assert set(discovery.get_plugins()) == {"fake-detector",
+                                            "fake-laser-plugin"}
+    assert set(discovery.get_plugins(default_enabled=True)) == {
+        "fake-detector", "fake-laser-plugin"}
+
+
+def test_build_plugin(discovery):
+    plugin = discovery.build_plugin("fake-detector")
+    assert isinstance(plugin, FakeDetector)
+    with pytest.raises(ValueError):
+        discovery.build_plugin("missing")
+
+
+def test_loader_dispatch(discovery):
+    loader = MythrilPluginLoader()
+    detector = discovery.build_plugin("fake-detector")
+    loader.load(detector)
+    registered = [type(m).__name__
+                  for m in ModuleLoader().get_detection_modules()]
+    assert "FakeDetector" in registered
+    # laser plugins land in the engine plugin loader as builders
+    laser = discovery.build_plugin("fake-laser-plugin")
+    loader.load(laser)
+    assert "fake-laser-plugin" in LaserPluginLoader().laser_plugin_builders
+
+    class Unknown(MythrilPlugin):
+        pass
+
+    with pytest.raises(UnsupportedPluginType):
+        loader.load(Unknown())
+
+    # cleanup: drop the fake detector so later tests see the stock 18
+    ModuleLoader()._modules = [
+        m for m in ModuleLoader()._modules
+        if type(m).__name__ != "FakeDetector"]
+    LaserPluginLoader().laser_plugin_builders.pop("fake-laser-plugin", None)

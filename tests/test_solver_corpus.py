@@ -1,0 +1,107 @@
+"""Differential replay of REAL solver queries (VERDICT r2 next-step #1).
+
+tests/data/smt2_corpus.tar.gz holds 171 .smt2 queries captured via
+`--solver-log` from actual analyses of the reference's testdata contracts
+(origin/suicide/exceptions/returnvalue/overflow/underflow/calls/metacoin/
+ether_send at -t 1 and -t 2) — not toy CNFs (every one blasts to >=60k
+clauses; the keccak interval axioms alone carry division circuits). Each
+sampled query is parsed back (smt/smtlib.py from_smt2) and replayed through
+the one-shot pipeline (lower -> blast -> native CDCL) and the incremental
+pipeline (persistent pool + assumption session), asserting verdict agreement
+and model validity. This is the test tier SURVEY §4 calls "differential
+solver tests on recorded constraint sets".
+
+The device (--solver jax) lane is differentially tested at two other tiers:
+random CNFs in tests/test_jax_solver.py, and end-to-end issue-set parity in
+test_device_backend_issue_parity below — real bit-blasted analysis queries
+exceed the dense DPLL's clause cap by design and fall back to the CDCL
+session (the fallback path is itself under test here)."""
+
+import os
+import tarfile
+
+import pytest
+
+from mythril_tpu.smt.smtlib import from_smt2
+from mythril_tpu.smt.solver import sat
+from mythril_tpu.smt.solver.bitblast import Blaster
+from mythril_tpu.smt.solver.incremental import IncrementalPipeline
+from mythril_tpu.smt.solver.preprocess import lower_constraints
+
+CORPUS = os.path.join(os.path.dirname(__file__), "data", "smt2_corpus.tar.gz")
+
+#: every Nth query (full corpus ~= 171 queries x 2 solves x >=60k clauses is
+#: CI-hostile; the sample still spans all nine source contracts)
+SAMPLE_STRIDE = 4
+
+pytestmark = pytest.mark.skipif(not sat.have_native(),
+                                reason="native CDCL build required")
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    queries = []
+    with tarfile.open(CORPUS) as tar:
+        members = [m for m in tar.getmembers() if m.name.endswith(".smt2")]
+        assert len(members) >= 100, "corpus shrank below the 100-query bar"
+        for member in members[::SAMPLE_STRIDE]:
+            handle = tar.extractfile(member)
+            queries.append((member.name,
+                            from_smt2(handle.read().decode("utf-8"))))
+    return queries
+
+
+def _oneshot_cnf(constraints):
+    lowered, _ = lower_constraints(list(constraints))
+    blaster = Blaster()
+    for node in lowered:
+        blaster.assert_true(node)
+    return blaster.clauses, blaster.n_vars
+
+
+def test_oneshot_vs_incremental(corpus):
+    """The incremental session must agree with a from-scratch solve on every
+    sampled captured query (same conflict budget both sides)."""
+    pipeline = IncrementalPipeline()
+    decided = 0
+    try:
+        for name, constraints in corpus:
+            clauses, n_vars = _oneshot_cnf(constraints)
+            ref_status, _ = sat.solve_cnf(clauses, n_vars, 100_000)
+            inc_verdict, inc_model = pipeline.check(constraints, 100_000)
+            got = {"sat": sat.SAT, "unsat": sat.UNSAT,
+                   "unknown": sat.UNKNOWN}[inc_verdict]
+            if ref_status == sat.UNKNOWN or got == sat.UNKNOWN:
+                continue
+            assert got == ref_status, \
+                f"{name}: oneshot {ref_status} != incremental {got}"
+            if inc_verdict == "sat":
+                for constraint in constraints:
+                    assert inc_model.eval(constraint), \
+                        f"{name}: incremental model violates a constraint"
+            decided += 1
+    finally:
+        pipeline.close()
+    assert decided >= len(corpus) * 0.7, \
+        f"only {decided}/{len(corpus)} queries decided by both backends"
+
+
+def test_device_backend_issue_parity():
+    """VERDICT r2 done-criterion: `analyze --solver jax` must report the
+    identical issue set as `--solver cdcl` (the r2 build reported zero issues
+    because a TPU-side crash was swallowed)."""
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_analysis import analyze, KILLBILLY
+
+    from mythril_tpu.support.support_args import args
+
+    baseline = analyze(KILLBILLY, modules=["AccidentallyKillable"], tx_count=2)
+    args.solver = "jax"
+    try:
+        device = analyze(KILLBILLY, modules=["AccidentallyKillable"],
+                         tx_count=2)
+    finally:
+        args.solver = "cdcl"
+    assert sorted(i.swc_id for i in device) == sorted(
+        i.swc_id for i in baseline) == ["106"]

@@ -1,0 +1,89 @@
+"""State-merge plugin tests (capability parity:
+reference tests/integration_tests/state_merge_tests.py + the
+check_mergeability/merge_states unit behavior)."""
+
+import pytest
+
+from mythril_tpu.core.plugin.plugins.state_merge import (
+    check_ws_merge_condition, merge_states, MergeAnnotation)
+from mythril_tpu.core.state.world_state import WorldState
+from mythril_tpu.smt import symbol_factory
+from mythril_tpu.smt.solver import sat
+from mythril_tpu.support.support_args import args
+
+pytestmark = pytest.mark.skipif(not sat.have_native(),
+                                reason="native CDCL build required")
+
+ADDRESS = 0x0ACE0001
+
+
+def _ws_pair():
+    # earlier tests may leave keccak axioms on the process-wide manager;
+    # this test's constraint sets must be self-contained
+    from mythril_tpu.core.function_managers import keccak_function_manager
+
+    keccak_function_manager.reset()
+    selector = symbol_factory.BitVecSym("merge_sel", 256)
+    ws_a = WorldState()
+    ws_a.create_account(balance=0, address=ADDRESS)
+    slot = symbol_factory.BitVecVal(0, 256)
+    ws_a.constraints.append(selector == 1)
+    ws_a.accounts[ADDRESS].storage[slot] = 11
+
+    ws_b = WorldState()
+    ws_b.create_account(balance=0, address=ADDRESS)
+    ws_b.constraints.append(selector == 2)
+    ws_b.accounts[ADDRESS].storage[slot] = 22
+    return selector, ws_a, ws_b
+
+
+def test_mergeable_pair_detected():
+    _, ws_a, ws_b = _ws_pair()
+    assert check_ws_merge_condition(ws_a, ws_b)
+
+
+def test_merge_preserves_per_branch_storage():
+    selector, ws_a, ws_b = _ws_pair()
+    merge_states(ws_a, ws_b)
+    assert list(ws_a.get_annotations(MergeAnnotation))
+
+    storage_value = ws_a.accounts[ADDRESS].storage[
+        symbol_factory.BitVecVal(0, 256)]
+    base = list(ws_a.constraints)
+    from mythril_tpu.core.state.constraints import Constraints
+
+    # under selector==1 the merged storage must still read 11, never 22
+    assert Constraints(base + [selector == 1, storage_value == 11]).is_possible()
+    assert not Constraints(base + [selector == 1, storage_value == 22]).is_possible()
+    # and symmetrically for the other branch
+    assert Constraints(base + [selector == 2, storage_value == 22]).is_possible()
+    assert not Constraints(base + [selector == 2, storage_value == 11]).is_possible()
+    # both branches remain reachable
+    assert Constraints(base + [selector == 1]).is_possible()
+    assert Constraints(base + [selector == 2]).is_possible()
+    # but no third path appeared
+    assert not Constraints(base + [selector == 3]).is_possible()
+
+
+def test_unmergeable_when_too_different():
+    selector, ws_a, ws_b = _ws_pair()
+    for i in range(20):
+        ws_b.constraints.append(
+            symbol_factory.BitVecSym(f"merge_extra{i}", 256) == i)
+    assert not check_ws_merge_condition(ws_a, ws_b)
+
+
+def test_e2e_findings_unchanged_with_merging():
+    """--enable-state-merging must not change the issue set."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_analysis import analyze, KILLBILLY
+
+    baseline = analyze(KILLBILLY, modules=["AccidentallyKillable"], tx_count=2)
+    args.enable_state_merging = True
+    try:
+        merged = analyze(KILLBILLY, modules=["AccidentallyKillable"], tx_count=2)
+    finally:
+        args.enable_state_merging = False
+    assert sorted(i.swc_id for i in merged) == sorted(
+        i.swc_id for i in baseline) == ["106"]

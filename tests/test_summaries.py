@@ -1,0 +1,66 @@
+"""Symbolic-summary plugin tests (capability parity: reference
+tests/integration_tests/summary_test.py — findings unchanged with
+--enable-summaries on a multi-transaction contract)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from mythril_tpu.smt.solver import sat
+from mythril_tpu.support.support_args import args
+
+pytestmark = pytest.mark.skipif(not sat.have_native(),
+                                reason="native CDCL build required")
+
+
+def _analyze_with_summaries(contract, modules, tx_count):
+    from test_analysis import analyze
+
+    args.enable_summaries = True
+    try:
+        return analyze(contract, modules=modules, tx_count=tx_count)
+    finally:
+        args.enable_summaries = False
+        args.use_issue_annotations = False
+
+
+def test_killbilly_findings_unchanged():
+    """The 2-tx selfdestruct chain must survive summary replay: tx1 records
+    the activation summary, tx2's kill validates against it."""
+    from test_analysis import analyze, KILLBILLY
+
+    baseline = analyze(KILLBILLY, modules=["AccidentallyKillable"], tx_count=2)
+    summarized = _analyze_with_summaries(
+        KILLBILLY, modules=["AccidentallyKillable"], tx_count=2)
+    assert sorted(i.swc_id for i in summarized) == sorted(
+        i.swc_id for i in baseline) == ["106"]
+
+
+def test_safe_contract_still_clean():
+    from test_analysis import SAFE_KILL
+
+    summarized = _analyze_with_summaries(
+        SAFE_KILL, modules=["AccidentallyKillable"], tx_count=2)
+    assert summarized == []
+
+
+def test_summaries_are_recorded():
+    from mythril_tpu.core.plugin.plugins.summary import SymbolicSummaryPlugin
+    from mythril_tpu.core.plugin import LaserPluginLoader
+    from test_analysis import analyze, KILLBILLY
+
+    args.enable_summaries = True
+    try:
+        analyze(KILLBILLY, modules=["AccidentallyKillable"], tx_count=2)
+        plugin = LaserPluginLoader().plugin_list.get("symbolic-summaries")
+        assert plugin is not None
+        assert isinstance(plugin, SymbolicSummaryPlugin)
+        # the activation tx mutates storage -> at least one recorded summary
+        assert len(plugin.summaries) >= 1
+        assert all(s.as_dict for s in plugin.summaries)
+    finally:
+        args.enable_summaries = False
+        args.use_issue_annotations = False

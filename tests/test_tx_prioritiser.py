@@ -1,0 +1,129 @@
+"""RF tx prioritizer + feature extractor tests (capability parity:
+reference tests/features_test.py + tx_prioritiser/rf_prioritiser.py)."""
+
+import numpy as np
+
+from mythril_tpu.core.tx_prioritiser import (FEATURE_KEYS, HeuristicRiskModel,
+                                             RfTxPrioritiser)
+from mythril_tpu.frontends.features import SolidityFeatureExtractor
+
+# minimal solc-style AST: two functions, one with selfdestruct guarded by an
+# owner modifier, one payable with a require
+AST = {
+    "nodeType": "SourceUnit",
+    "nodes": [{
+        "nodeType": "ContractDefinition",
+        "nodes": [
+            {
+                "nodeType": "ModifierDefinition",
+                "name": "onlyOwner",
+                "body": {
+                    "nodeType": "Block",
+                    "statements": [{
+                        "nodeType": "ExpressionStatement",
+                        "expression": {
+                            "nodeType": "FunctionCall",
+                            "expression": {"nodeType": "Identifier",
+                                           "name": "require"},
+                            "arguments": [{
+                                "nodeType": "BinaryOperation",
+                                "leftExpression": {"nodeType": "Identifier",
+                                                   "name": "owner"},
+                                "rightExpression": {"nodeType": "Identifier",
+                                                    "name": "msg_sender"},
+                            }],
+                        },
+                    }],
+                },
+            },
+            {
+                "nodeType": "FunctionDefinition",
+                "name": "kill",
+                "stateMutability": "nonpayable",
+                "modifiers": [
+                    {"modifierName": {"name": "onlyOwner"}}],
+                "body": {
+                    "nodeType": "Block",
+                    "statements": [{
+                        "nodeType": "ExpressionStatement",
+                        "expression": {
+                            "nodeType": "FunctionCall",
+                            "expression": {"nodeType": "Identifier",
+                                           "name": "selfdestruct"},
+                            "arguments": [],
+                        },
+                    }],
+                },
+            },
+            {
+                "nodeType": "FunctionDefinition",
+                "name": "deposit",
+                "stateMutability": "payable",
+                "modifiers": [],
+                "body": {
+                    "nodeType": "Block",
+                    "statements": [{
+                        "nodeType": "ExpressionStatement",
+                        "expression": {
+                            "nodeType": "FunctionCall",
+                            "expression": {"nodeType": "Identifier",
+                                           "name": "require"},
+                            "arguments": [{"nodeType": "Identifier",
+                                           "name": "amount"}],
+                        },
+                    }],
+                },
+            },
+        ],
+    }],
+}
+
+
+def test_feature_extraction():
+    features = SolidityFeatureExtractor(AST).extract_features()
+    assert set(features) == {"kill", "deposit"}
+    kill = features["kill"]
+    assert kill["contains_selfdestruct"] is True
+    assert kill["has_owner_modifier"] is True
+    assert kill["is_payable"] is False
+    # modifier's require vars propagate into the function
+    assert {"owner", "msg_sender"} <= kill["all_require_vars"]
+    deposit = features["deposit"]
+    assert deposit["is_payable"] is True
+    assert deposit["contains_selfdestruct"] is False
+    assert "amount" in deposit["all_require_vars"]
+
+
+class _Contract:
+    def __init__(self, features):
+        self.features = features
+
+
+def test_prioritiser_predicts_sequences():
+    features = SolidityFeatureExtractor(AST).extract_features()
+    prioritiser = RfTxPrioritiser(_Contract(features), depth=3)
+    sequence = prioritiser.__next__(address=None)
+    assert len(sequence) == 3
+    assert all(0 <= i < 2 for i in sequence)
+    # selfdestruct-bearing kill() ranks first despite the owner modifier
+    assert sequence[0] == prioritiser.function_names.index("kill")
+    # a second prediction round still works and varies with history
+    sequence2 = prioritiser.__next__(address=None)
+    assert len(sequence2) == 3
+
+
+def test_prioritiser_disabled_without_features():
+    prioritiser = RfTxPrioritiser(_Contract(None))
+    assert prioritiser.model is None
+    assert prioritiser.__next__(address=None) == []
+
+
+def test_heuristic_model_shape():
+    model = HeuristicRiskModel(n_functions=2,
+                               per_function=len(FEATURE_KEYS))
+    static = np.zeros(2 * len(FEATURE_KEYS))
+    static[0] = 1.0  # function 0: contains_selfdestruct
+    probabilities = model.predict_proba(static.reshape(1, -1))
+    assert probabilities.shape == (1, 2)
+    assert abs(float(probabilities.sum()) - 1.0) < 1e-9
+    assert probabilities[0, 0] > probabilities[0, 1]
