@@ -42,7 +42,7 @@ class SymExecWrapper:
                  compulsory_statespace: bool = True,
                  disable_dependency_pruning: bool = False,
                  run_analysis_modules: bool = True, enable_coverage_strategy: bool = False,
-                 custom_modules_directory: str = ""):
+                 custom_modules_directory: str = "", engine: str = "host"):
         if isinstance(address, str):
             address = symbol_factory.BitVecVal(int(address, 16), 256)
         elif isinstance(address, int):
@@ -88,6 +88,7 @@ class SymExecWrapper:
             transaction_count=transaction_count,
             requires_statespace=requires_statespace,
             tx_strategy=tx_strategy,
+            engine=engine,
         )
         if loop_bound is not None:
             self.laser.extend_strategy(BoundedLoopsStrategy,

@@ -53,7 +53,11 @@ class LaserEVM:
                  iprof=None, use_reachability_check: bool = True,
                  beam_width: Optional[int] = None,
                  tx_strategy: Optional[str] = None,
-                 pruning_factor: Optional[float] = None):
+                 pruning_factor: Optional[float] = None,
+                 engine: str = "host"):
+        #: "host" = Python worklist; "tpu" = device symbolic frontier
+        #: (parallel/frontier.py) with host continuation of escaped lanes
+        self.engine = engine
         self.dynamic_loader = dynamic_loader
         self.open_states: List[WorldState] = []
         self.total_states = 0
@@ -158,10 +162,15 @@ class LaserEVM:
                      "%d initial states", i, len(self.open_states))
             for hook in self._start_sym_trans_hooks:
                 hook()
-            execute_message_call(
-                self, address,
-                func_hashes=(predicted_hashes[i]
-                             if i < len(predicted_hashes) else None))
+            if self.engine == "tpu":
+                from ..parallel.frontier import execute_message_call_tpu
+
+                execute_message_call_tpu(self, address)
+            else:
+                execute_message_call(
+                    self, address,
+                    func_hashes=(predicted_hashes[i]
+                                 if i < len(predicted_hashes) else None))
             for hook in self._stop_sym_trans_hooks:
                 hook()
 

@@ -60,6 +60,9 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
     options.add_argument("--solver-log", help="directory for .smt2 query dumps")
     options.add_argument("--solver", default="cdcl", choices=["cdcl", "jax"],
                          help="SAT backend: native CDCL or batched TPU solver")
+    options.add_argument("--engine", default="host", choices=["host", "tpu"],
+                         help="exploration engine: host worklist or the "
+                              "batched TPU symbolic frontier")
     options.add_argument("--beam-width", type=int, default=None)
     options.add_argument("--transaction-sequences", default=None,
                          help="explicit function-sequence list (json)")

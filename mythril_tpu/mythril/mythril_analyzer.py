@@ -37,6 +37,7 @@ class MythrilAnalyzer:
         self.loop_bound = getattr(cmd, "loop_bound", 3)
         self.create_timeout = getattr(cmd, "create_timeout", 10)
         self.max_depth = getattr(cmd, "max_depth", 128)
+        self.engine = getattr(cmd, "engine", "host") or "host"
         self.disable_dependency_pruning = getattr(
             cmd, "disable_dependency_pruning", False)
         self.custom_modules_directory = getattr(
@@ -116,7 +117,8 @@ class MythrilAnalyzer:
                     modules=modules,
                     compulsory_statespace=False,
                     disable_dependency_pruning=self.disable_dependency_pruning,
-                    custom_modules_directory=self.custom_modules_directory)
+                    custom_modules_directory=self.custom_modules_directory,
+                    engine=self.engine)
                 issues = fire_lasers(sym, modules)
             except KeyboardInterrupt:
                 log.critical("analysis interrupted, saving issues found so far")

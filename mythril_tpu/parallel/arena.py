@@ -1,0 +1,333 @@
+"""Expression arena: the frontier's symbolic values as dense device tables.
+
+SURVEY §7's "tensorized IR": where the host engine wraps every symbolic word
+in a Python object over the hash-consed term DAG (smt/terms.py), the device
+frontier represents a symbolic word as ONE int32 — an index into append-only
+arena tables. Building a new expression is a scatter write plus a bump of the
+allocation pointer, so a batch of lanes each producing a node per step costs
+one cumsum + one scatter, not a Python object per lane.
+
+Layout (all capacities static):
+    op:   int32[CAP]    node kind — an EVM opcode byte (ADD, SUB, EQ, ...)
+                         or one of the special tags below
+    a,b,c: int32[CAP]   child node ids (0 = absent; node 0 is reserved)
+    imm:  int32[CAP]    payload: const-pool index (CONST), var class (VAR),
+                         or auxiliary immediate (BYTE index, SIGNEXTEND size)
+    imm2: int32[CAP]    second payload (VAR: e.g. calldata byte offset)
+    n:    int32[]       bump pointer (next free id)
+    const_vals: uint32[CCAP, NLIMBS]  const pool (256-bit words)
+    n_const:    int32[]
+
+The host side converts arena nodes to smt terms (`to_term`) when a lane is
+materialized into a GlobalState or its path condition is checked for
+feasibility — variable leaves are rendered with the SAME naming scheme the
+host engine uses (sender_{tx}, {tx}_calldata, ...) so materialized states are
+indistinguishable from host-explored ones and witness extraction works
+unchanged (core/transaction/symbolic.py:91-103)."""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import words
+
+I32 = jnp.int32
+
+# -- special node tags (beyond EVM opcode bytes) --------------------------------------
+CONST = 0x100   # imm = const-pool index
+VAR = 0x101     # imm = var class, imm2 = qualifier
+
+# -- var classes ----------------------------------------------------------------------
+V_CALLDATA_WORD = 1   # imm2 = byte offset; 32-byte word at offset
+V_CALLDATASIZE = 2
+V_CALLER = 3
+V_ORIGIN = 4
+V_CALLVALUE = 5
+V_GASPRICE = 6
+V_TIMESTAMP = 7
+V_NUMBER = 8
+V_COINBASE = 9
+V_PREVRANDAO = 11
+V_BASEFEE = 12
+#: imm2 = index into the seeding TxContext's host_terms list — how arbitrary
+#: host expressions (e.g. creation-time symbolic storage values) ride into
+#: the device frontier as opaque leaves
+V_HOST_TERM = 15
+
+#: var classes whose value a miner/attacker can steer (dependence detectors
+#: need a host visit when a branch condition contains one)
+PREDICTABLE_CLASSES = frozenset({V_TIMESTAMP, V_NUMBER, V_COINBASE,
+                                 V_PREVRANDAO})
+
+
+class Arena(NamedTuple):
+    op: jnp.ndarray          # int32[CAP]
+    a: jnp.ndarray           # int32[CAP]
+    b: jnp.ndarray           # int32[CAP]
+    c: jnp.ndarray           # int32[CAP]
+    imm: jnp.ndarray         # int32[CAP]
+    imm2: jnp.ndarray        # int32[CAP]
+    n: jnp.ndarray           # int32[] — next free node id
+    const_vals: jnp.ndarray  # uint32[CCAP, NLIMBS]
+    n_const: jnp.ndarray     # int32[]
+
+    @property
+    def capacity(self) -> int:
+        return self.op.shape[0]
+
+
+def new_arena(capacity: int = 1 << 18, const_capacity: int = 1 << 14) -> Arena:
+    return Arena(
+        op=jnp.zeros(capacity, dtype=I32),
+        a=jnp.zeros(capacity, dtype=I32),
+        b=jnp.zeros(capacity, dtype=I32),
+        c=jnp.zeros(capacity, dtype=I32),
+        imm=jnp.zeros(capacity, dtype=I32),
+        imm2=jnp.zeros(capacity, dtype=I32),
+        n=jnp.asarray(1, dtype=I32),  # node 0 reserved = "concrete"
+        const_vals=jnp.zeros((const_capacity, words.NLIMBS), dtype=jnp.uint32),
+        n_const=jnp.asarray(0, dtype=I32),
+    )
+
+
+def alloc_rows(arena: Arena, want: jnp.ndarray, op: jnp.ndarray,
+               a: jnp.ndarray, b: jnp.ndarray, c: jnp.ndarray,
+               imm: jnp.ndarray, imm2: jnp.ndarray):
+    """Allocate one node per lane where `want` (bool[B]); returns
+    (arena', node_ids int32[B] — 0 where not wanted). Out-of-capacity lanes
+    get id 0 and must be escaped by the caller (overflow flag returned)."""
+    rank = jnp.cumsum(want.astype(I32)) - 1
+    ids = arena.n + rank
+    overflow = want & (ids >= arena.capacity)
+    ok = want & ~overflow
+    slot = jnp.where(ok, ids, arena.capacity)  # OOB -> dropped write
+    new = arena._replace(
+        op=arena.op.at[slot].set(op, mode="drop"),
+        a=arena.a.at[slot].set(a, mode="drop"),
+        b=arena.b.at[slot].set(b, mode="drop"),
+        c=arena.c.at[slot].set(c, mode="drop"),
+        imm=arena.imm.at[slot].set(imm, mode="drop"),
+        imm2=arena.imm2.at[slot].set(imm2, mode="drop"),
+        n=jnp.minimum(arena.n + jnp.sum(want.astype(I32)),
+                      arena.capacity).astype(I32),
+    )
+    return new, jnp.where(ok, ids, 0).astype(I32), overflow
+
+
+def alloc_consts(arena: Arena, want: jnp.ndarray, value_words: jnp.ndarray):
+    """Allocate CONST nodes wrapping per-lane 256-bit words where `want`.
+    Returns (arena', node_ids, overflow)."""
+    crank = jnp.cumsum(want.astype(I32)) - 1
+    cids = arena.n_const + crank
+    coverflow = want & (cids >= arena.const_vals.shape[0])
+    cok = want & ~coverflow
+    cslot = jnp.where(cok, cids, arena.const_vals.shape[0])
+    arena = arena._replace(
+        const_vals=arena.const_vals.at[cslot].set(value_words, mode="drop"),
+        n_const=jnp.minimum(arena.n_const + jnp.sum(want.astype(I32)),
+                            arena.const_vals.shape[0]).astype(I32),
+    )
+    arena, ids, overflow = alloc_rows(
+        arena, cok, jnp.full_like(cids, CONST), jnp.zeros_like(cids),
+        jnp.zeros_like(cids), jnp.zeros_like(cids), cids.astype(I32),
+        jnp.zeros_like(cids))
+    return arena, ids, overflow | coverflow
+
+
+# -- host-side conversion -------------------------------------------------------------
+
+#: arena op byte -> terms constructor name for binary BV ops
+_BINOP = {
+    0x01: "bvadd", 0x02: "bvmul", 0x03: "bvsub", 0x04: "bvudiv",
+    0x05: "bvsdiv", 0x06: "bvurem", 0x07: "bvsrem",
+    0x16: "bvand", 0x17: "bvor", 0x18: "bvxor",
+}
+_SHIFTS = {0x1B: "bvshl", 0x1C: "bvlshr", 0x1D: "bvashr"}
+_CMP = {0x10: ("bvult", False), 0x11: ("bvult", True),   # LT, GT(swap)
+        0x12: ("bvslt", False), 0x13: ("bvslt", True),   # SLT, SGT(swap)
+        0x14: ("eq", False)}                             # EQ
+
+
+class HostArena:
+    """Host snapshot of the arena tables + memoized term conversion."""
+
+    def __init__(self, arena: Arena):
+        self.op = np.asarray(arena.op)
+        self.a = np.asarray(arena.a)
+        self.b = np.asarray(arena.b)
+        self.c = np.asarray(arena.c)
+        self.imm = np.asarray(arena.imm)
+        self.imm2 = np.asarray(arena.imm2)
+        self.n = int(arena.n)
+        self.const_vals = np.asarray(arena.const_vals)
+        self._memo: Dict[int, object] = {}
+        self._var_memo: Dict[int, set] = {}
+
+    def to_term(self, node_id: int, ctx: "TxContext"):
+        """Arena node -> smt BitVec (host term), via ctx's variable leaves."""
+        from ..smt import BitVec
+
+        result = self._convert(int(node_id), ctx)
+        assert isinstance(result, BitVec)
+        return result
+
+    def _convert(self, node_id: int, ctx: "TxContext"):
+        from ..smt import BitVec, symbol_factory
+        from ..smt import terms as T
+
+        memo = self._memo
+        key = (node_id, id(ctx))  # var leaves differ per seeding context
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
+        op = int(self.op[node_id])
+        if op == CONST:
+            value = words.to_ints(self.const_vals[int(self.imm[node_id])])
+            result = symbol_factory.BitVecVal(int(value), 256)
+        elif op == VAR:
+            result = ctx.var(int(self.imm[node_id]), int(self.imm2[node_id]))
+        else:
+            ca = self._convert(int(self.a[node_id]), ctx) \
+                if self.a[node_id] else None
+            cb = self._convert(int(self.b[node_id]), ctx) \
+                if self.b[node_id] else None
+            # detector taint (OriginAnnotation etc.) flows through wrapper
+            # annotations exactly as in host execution (smt/bitvec.py ops)
+            annotations = set()
+            for child in (ca, cb):
+                if child is not None:
+                    annotations |= child.annotations
+
+            def bv(term):
+                return BitVec(term, annotations)
+
+            if op in _BINOP:
+                raw = T.bv_binop(_BINOP[op], ca.raw, cb.raw)
+                if op in (0x04, 0x05, 0x06, 0x07):
+                    # EVM division semantics: x/0 = 0, x%0 = 0 — SMT-LIB
+                    # gives all-ones / x (host guard: instructions.py div_)
+                    raw = T.ite(T.bv_cmp("eq", cb.raw, T.bv_const(0, 256)),
+                                T.bv_const(0, 256), raw)
+                result = bv(raw)
+            elif op in _SHIFTS:
+                # EVM shift operand order: (shift, value)
+                result = bv(T.bv_binop(_SHIFTS[op], cb.raw, ca.raw))
+            elif op in _CMP:
+                kind, swap = _CMP[op]
+                left, right = (cb, ca) if swap else (ca, cb)
+                cond = T.bv_cmp(kind, left.raw, right.raw)
+                result = bv(T.ite(cond, T.bv_const(1, 256),
+                                  T.bv_const(0, 256)))
+            elif op == 0x15:  # ISZERO
+                cond = T.bv_cmp("eq", ca.raw, T.bv_const(0, 256))
+                result = bv(T.ite(cond, T.bv_const(1, 256),
+                                  T.bv_const(0, 256)))
+            elif op == 0x19:  # NOT
+                result = bv(T.bv_not(ca.raw))
+            elif op == 0x1A:  # BYTE(i, x): i = child a, x = child b
+                shift = T.bv_binop(
+                    "bvmul",
+                    T.bv_binop("bvsub", T.bv_const(31, 256), ca.raw),
+                    T.bv_const(8, 256))
+                shifted = T.bv_binop("bvlshr", cb.raw, shift)
+                result = bv(T.bv_binop("bvand", shifted,
+                                       T.bv_const(0xFF, 256)))
+            elif op == 0x0B:  # SIGNEXTEND(size=a, value=b)
+                size = ca.raw
+                if size.is_const and size.value < 32:
+                    bits = 8 * (size.value + 1)
+                    result = bv(T.sext(T.extract(bits - 1, 0, cb.raw),
+                                       256 - bits))
+                else:
+                    result = cb
+            elif op == 0x0A:  # EXP -> the host Power UF
+                from ..core.function_managers import \
+                    exponent_function_manager
+
+                result, _ = exponent_function_manager.create_condition(ca, cb)
+            elif op == 0x0F:  # internal: ite(cond=a, then=b, else=c)
+                cc = self._convert(int(self.c[node_id]), ctx)
+                cond = T.bool_not(T.bv_cmp("eq", ca.raw, T.bv_const(0, 256)))
+                result = bv(T.ite(cond, cb.raw, cc.raw))
+            else:
+                raise ValueError(f"arena node {node_id}: unknown op {op:#x}")
+        memo[key] = result
+        return result
+
+    def var_classes(self, node_id: int) -> set:
+        """All VAR classes reachable from node_id (drives detector-relevant
+        escape decisions: origin-tainted or predictable branch conditions)."""
+        hit = self._var_memo.get(node_id)
+        if hit is not None:
+            return hit
+        stack, seen, classes = [int(node_id)], set(), set()
+        while stack:
+            node = stack.pop()
+            if node in seen or node == 0:
+                continue
+            seen.add(node)
+            if int(self.op[node]) == VAR:
+                classes.add(int(self.imm[node]))
+            else:
+                stack.extend((int(self.a[node]), int(self.b[node]),
+                              int(self.c[node])))
+        self._var_memo[int(node_id)] = classes
+        return classes
+
+
+class TxContext:
+    """Variable leaves for one (open state, transaction) seeding — rendered
+    with the host engine's naming so materialized states interoperate."""
+
+    def __init__(self, tx_id: str, calldata, environment):
+        self.tx_id = tx_id
+        self.calldata = calldata          # SymbolicCalldata
+        self.environment = environment    # host Environment
+        self.host_terms: list = []        # V_HOST_TERM leaves (BitVec)
+
+    def var(self, var_class: int, qualifier: int):
+        from ..smt import symbol_factory
+
+        env = self.environment
+        if var_class == V_CALLDATA_WORD:
+            return self.calldata.get_word_at(qualifier)
+        if var_class == V_CALLDATASIZE:
+            return self.calldata.calldatasize
+        if var_class == V_CALLER:
+            return env.sender
+        if var_class == V_ORIGIN:
+            # carry the taint the host's origin_ handler would attach, so the
+            # TxOrigin detector fires on materialized states too
+            from ..analysis.modules.dependence_on_origin import \
+                OriginAnnotation
+
+            origin = env.origin
+            if not list(origin.get_annotations(OriginAnnotation)):
+                origin.annotate(OriginAnnotation())
+            return origin
+        if var_class == V_CALLVALUE:
+            return env.callvalue
+        if var_class == V_GASPRICE:
+            return env.gasprice
+        if var_class == V_BASEFEE:
+            return env.basefee
+        if var_class == V_HOST_TERM:
+            return self.host_terms[qualifier]
+        # block attributes: exact host naming (instructions.py:535-555,
+        # GlobalState.new_bitvec prefixes the tx id)
+        name = {V_TIMESTAMP: "timestamp", V_NUMBER: "block_number",
+                V_COINBASE: "coinbase", V_PREVRANDAO: "prevrandao"}.get(
+                    var_class)
+        if name is not None:
+            from ..analysis.modules.dependence_on_predictable_vars import \
+                PredictableValueAnnotation
+
+            operation = ("block.timestamp" if var_class == V_TIMESTAMP
+                         else f"block.{name}".replace("block.block_", "block."))
+            value = symbol_factory.BitVecSym(f"{self.tx_id}_{name}", 256)
+            value.annotate(PredictableValueAnnotation(operation))
+            return value
+        raise ValueError(f"unknown var class {var_class}")

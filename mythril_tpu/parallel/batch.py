@@ -25,10 +25,14 @@ from . import words
 
 # lane status values
 RUNNING, STOPPED, RETURNED, REVERTED, ERRORED, ESCAPED = 0, 1, 2, 3, 4, 5
+# symbolic-frontier statuses: lane paused at a symbolic JUMPI waiting for the
+# driver to duplicate it (FORKING); lane's path condition proved unsat (DEAD)
+FORKING, DEAD = 6, 7
 
 STATUS_NAMES = {
     RUNNING: "running", STOPPED: "stop", RETURNED: "return",
     REVERTED: "revert", ERRORED: "error", ESCAPED: "escape",
+    FORKING: "forking", DEAD: "dead",
 }
 
 

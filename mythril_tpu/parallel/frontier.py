@@ -1,0 +1,505 @@
+"""The TPU frontier driver: symbolic message-call exploration on device.
+
+`analyze --engine tpu` routes each symbolic transaction through here instead
+of the host worklist (core/transaction/symbolic.py execute_message_call).
+Every open world state seeds one device lane (pc=0, symbolic calldata/env,
+storage table from the world state); the batch runs fused symbolic steps
+(parallel/symstep.py) until lanes pause or leave:
+
+  - FORKING lanes (symbolic JUMPI) are serviced on host: the lane is
+    duplicated into a free slot, each side gets one path-constraint node, and
+    both sides are feasibility-checked through the incremental solver — the
+    shared constraint prefix makes consecutive checks nearly free
+    (smt/solver/incremental.py).
+  - Conditions containing tx.origin or block attributes are NOT forked on
+    device: the lane is handed to the host at the JUMPI so the dependence
+    detectors see it exactly as in host-only exploration.
+  - ESCAPED lanes (CALL family, SELFDESTRUCT, keccak over symbolic bytes,
+    RETURN/STOP/REVERT, ...) are materialized into full host GlobalStates —
+    stack/memory/storage/path conditions rebuilt as terms — and pushed onto
+    the host worklist: the host executes the instruction the device could
+    not, with all detector hooks firing unchanged.
+
+The device explores the cheap, hot part of the state space (dispatch,
+require-chains over calldata/env, storage guards) in lockstep; the host keeps
+everything heavy. The net replaces the reference's per-state Python stepping
+(mythril/laser/ethereum/svm.py:325-401) for the covered region."""
+
+from __future__ import annotations
+
+import logging
+from copy import copy
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.state.global_state import GlobalState
+from ..exceptions import UnsatError
+from ..smt import Bool, symbol_factory
+from ..smt import terms as T
+from . import arena as A
+from . import symstep
+from .batch import (DEAD, ERRORED, ESCAPED, FORKING, RUNNING, StateBatch,
+                    LaneSpec, build_batch)
+
+log = logging.getLogger(__name__)
+
+#: stop the device phase when the arena has less head-room than this
+ARENA_HEADROOM = 16_384
+#: fused steps between host services
+CHUNK = 8
+#: hard step budget per transaction phase
+MAX_STEPS = 4_096
+#: device lanes (seeds + fork capacity)
+DEFAULT_LANES = 128
+#: per-lane path-constraint capacity (conds plane)
+MAX_CONDS = 64
+
+
+class LaneContext(A.TxContext):
+    """Seeding context: one (open world state, transaction) pair."""
+
+    def __init__(self, tx_id: str, calldata, environment, template: GlobalState):
+        super().__init__(tx_id, calldata, environment)
+        self.template = template
+
+
+def _storage_entries(storage) -> Optional[List[Tuple[int, object]]]:
+    """Walk the storage store-chain into (concrete_key, BitVec_value) pairs
+    (latest store wins); None when the chain cannot seed a device table
+    (symbolic key, or a non-zero symbolic base)."""
+    from ..smt import BitVec
+
+    node = storage._standard_storage.raw
+    entries: Dict[int, object] = {}
+    while node.op == "store":
+        key, value = node.args[1], node.args[2]
+        if not key.is_const:
+            return None
+        entries.setdefault(key.value, BitVec(value))
+        node = node.args[0]
+    if node.op == "const_array":
+        if not (node.args[0].is_const and node.args[0].value == 0):
+            return None
+        return list(entries.items())
+    return None  # symbolic base array: host owns this state
+
+
+class _Frontier:
+    def __init__(self, laser_evm, n_lanes: int):
+        self.laser = laser_evm
+        self.n_lanes = n_lanes
+        self.contexts: List[LaneContext] = []
+        self.lane_ctx = np.full(n_lanes, -1, dtype=np.int64)
+        self.arena = A.new_arena()
+        self.materialized = 0
+        self.forks = 0
+        self.infeasible = 0
+        #: instruction-states executed on device (live lanes x steps) — the
+        #: symbolic analogue of the host engine's executed_nodes counter
+        self.lane_steps = 0
+
+    # -- seeding -----------------------------------------------------------------------
+
+    def seed(self, seed_states: List[GlobalState]) -> Optional[StateBatch]:
+        specs, planes_storage_sym = [], []
+        for template in seed_states:
+            account = template.environment.active_account
+            entries = _storage_entries(account.storage)
+            if entries is None:
+                return None  # caller falls back to host for everything
+            code_hex = template.environment.code.bytecode
+            specs.append((template, entries,
+                          bytes.fromhex(code_hex[2:] if code_hex.startswith("0x")
+                                        else code_hex)))
+
+        lane_specs = []
+        for template, entries, code in specs:
+            # symbolic-valued slots enter the table with a 0 placeholder so
+            # the slot EXISTS — storage_sym below overlays the arena node
+            # (otherwise device SLOADs would read concrete 0 for them)
+            table = {key: (value.raw.value if value.raw.is_const else 0)
+                     for key, value in entries}
+            lane_specs.append(LaneSpec(
+                code=code,
+                storage=table,
+                gas_limit=int(template.mstate.gas_limit),
+                address=template.environment.address.raw.value,
+            ))
+        # pad to capacity with dead lanes
+        while len(lane_specs) < self.n_lanes:
+            lane_specs.append(LaneSpec(code=b"\x00"))
+        state = build_batch(lane_specs)
+        planes = symstep.SymPlanes.empty(
+            self.n_lanes, state.stack.shape[1], state.memory.shape[1],
+            state.storage_keys.shape[1], MAX_CONDS)
+
+        status = np.zeros(self.n_lanes, dtype=np.int32)
+        status[len(specs):] = DEAD
+        state = state._replace(status=np.asarray(status))
+
+        storage_sym = np.zeros((self.n_lanes,
+                                state.storage_keys.shape[1]), dtype=np.int32)
+        for lane, (template, entries, _code) in enumerate(specs):
+            tx, _ = template.transaction_stack[-1]
+            ctx = LaneContext(str(tx.id), template.environment.calldata,
+                              template.environment, template)
+            self.contexts.append(ctx)
+            self.lane_ctx[lane] = len(self.contexts) - 1
+            # symbolic storage values ride in as host-term leaves
+            for key, value in entries:
+                if value.raw.is_const:
+                    continue
+                ctx.host_terms.append(value)
+                self.arena, node, _ovf = A.alloc_rows(
+                    self.arena,
+                    np.asarray([True]), np.asarray([A.VAR], dtype=np.int32),
+                    np.asarray([0], dtype=np.int32),
+                    np.asarray([0], dtype=np.int32),
+                    np.asarray([0], dtype=np.int32),
+                    np.asarray([A.V_HOST_TERM], dtype=np.int32),
+                    np.asarray([len(ctx.host_terms) - 1], dtype=np.int32))
+                slot = self._storage_slot_of(state, lane, key)
+                if slot is not None:
+                    storage_sym[lane, slot] = int(node[0])
+        planes = planes._replace(storage_sym=np.asarray(storage_sym))
+        return state, planes
+
+    @staticmethod
+    def _storage_slot_of(state: StateBatch, lane: int, key: int
+                         ) -> Optional[int]:
+        from . import words
+
+        used = np.asarray(state.storage_used[lane])
+        keys = np.asarray(state.storage_keys[lane])
+        for slot in range(used.shape[0]):
+            if used[slot] and int(words.to_ints(keys[slot])) == key:
+                return slot
+        return None
+
+    # -- host services -----------------------------------------------------------------
+
+    def run(self, state: StateBatch, planes: symstep.SymPlanes) -> None:
+        import os
+
+        from ..core.time_handler import time_handler
+
+        max_steps = int(os.environ.get("MYTHRIL_TPU_MAX_STEPS", MAX_STEPS))
+        steps = 0
+        while steps < max_steps:
+            if int(self.arena.n) > self.arena.capacity - ARENA_HEADROOM:
+                log.warning("arena head-room exhausted; handing remaining "
+                            "lanes to the host")
+                break
+            if time_handler.time_remaining() <= 1000:  # ms
+                log.info("execution budget exhausted; ending device phase")
+                break
+            live_before = np.asarray(state.status) == RUNNING
+            state, planes, self.arena = symstep.sym_step_many(
+                state, planes, self.arena, CHUNK)
+            steps += CHUNK
+            status = np.asarray(state.status)
+            # precise accounting: lanes that left mid-chunk (fork/escape/halt)
+            # froze after >=1 step — credit 1, not CHUNK
+            still_live = status == RUNNING
+            self.lane_steps += int(np.sum(live_before & still_live)) * CHUNK \
+                + int(np.sum(live_before & ~still_live))
+            if (status == FORKING).any() or (status == ESCAPED).any() \
+                    or not (status == RUNNING).any():
+                state, planes = self._service(state, planes)
+                status = np.asarray(state.status)
+            if not ((status == RUNNING) | (status == FORKING)).any():
+                return
+        # budget exhausted: surviving lanes continue on host
+        self._hand_over_running(state, planes)
+
+    def _service(self, state: StateBatch, planes: symstep.SymPlanes):
+        """Harvest escaped/halted lanes, fork paused lanes, prune unsat."""
+        status = np.array(state.status)  # writable copy
+        harena = A.HostArena(self.arena)
+
+        # harvest: escaped lanes go to the host worklist
+        for lane in np.nonzero(status == ESCAPED)[0]:
+            self._materialize(state, planes, harena, int(lane))
+            status[lane] = DEAD
+        # halted/errored lanes are done (the device executed STOP/RETURN/
+        # REVERT only via escape, so these are bookkeeping-only states)
+        for lane in np.nonzero((status == ERRORED))[0]:
+            status[lane] = DEAD
+
+        forking = np.nonzero(status == FORKING)[0]
+        if len(forking):
+            # np.asarray over device arrays yields read-only views; the fork
+            # service mutates lanes in place, so take writable copies
+            state_np = {field: np.array(getattr(state, field))
+                        for field in state._fields}
+            planes_np = {field: np.array(getattr(planes, field))
+                         for field in planes._fields}
+            for lane in forking:
+                self._fork_lane(state_np, planes_np, harena, status, int(lane))
+            state = StateBatch(**{f: state_np[f] for f in state._fields})
+            planes = symstep.SymPlanes(**{f: planes_np[f]
+                                          for f in planes._fields})
+        state = state._replace(status=np.asarray(status))
+        return state, planes
+
+    def _fork_lane(self, state_np, planes_np, harena, status, lane: int):
+        ctx = self.contexts[self.lane_ctx[lane]]
+        cond_node = int(planes_np["fork_cond"][lane])
+        classes = harena.var_classes(cond_node)
+        if classes & (A.PREDICTABLE_CLASSES | {A.V_ORIGIN}):
+            # dependence detectors must see this JUMPI on host
+            self._materialize_np(state_np, planes_np, harena, lane,
+                                 status_override=None)
+            status[lane] = DEAD
+            return
+        free = np.nonzero(status == DEAD)[0]
+        count = int(planes_np["cond_count"][lane])
+        if not len(free) or count + 1 > MAX_CONDS:
+            self._materialize_np(state_np, planes_np, harena, lane)
+            status[lane] = DEAD
+            return
+        target = int(free[0])
+        self.forks += 1
+
+        # duplicate the lane
+        for field, table in state_np.items():
+            table[target] = table[lane]
+        for field, table in planes_np.items():
+            table[target] = table[lane]
+        self.lane_ctx[target] = self.lane_ctx[lane]
+
+        # taken side: pc = dest (already on the stack top), constraint +node
+        from . import words
+
+        sp = int(state_np["sp"][lane])
+        fork_pc = int(state_np["pc"][lane])  # before either side mutates it
+        dest = int(words.to_ints(state_np["stack"][lane, sp - 1]))
+        code_cap = state_np["code"].shape[1]
+        dest_ok = 0 <= dest < code_cap and bool(state_np["jumpdest"][lane, dest])
+
+        for side, is_taken in ((lane, True), (target, False)):
+            state_np["sp"][side] = sp - 2
+            planes_np["stack_sym"][side, sp - 2:] = 0
+            planes_np["fork_cond"][side] = 0
+            if is_taken:
+                if not dest_ok:
+                    status[side] = DEAD  # invalid destination branch
+                    continue
+                state_np["pc"][side] = dest
+            else:
+                state_np["pc"][side] = fork_pc + 1
+            signed = cond_node if is_taken else -cond_node
+            planes_np["conds"][side, count] = signed
+            planes_np["cond_count"][side] = count + 1
+            if self._feasible(planes_np, harena, side):
+                status[side] = RUNNING
+            else:
+                status[side] = DEAD
+                self.infeasible += 1
+
+    def _cond_bools(self, planes_np, harena, lane: int) -> List[Bool]:
+        ctx = self.contexts[self.lane_ctx[lane]]
+        bools: List[Bool] = []
+        for position in range(int(planes_np["cond_count"][lane])):
+            signed = int(planes_np["conds"][lane, position])
+            word = harena.to_term(abs(signed), ctx)
+            is_zero = T.bv_cmp("eq", word.raw, T.bv_const(0, 256))
+            bools.append(Bool(T.bool_not(is_zero) if signed > 0 else is_zero))
+        return bools
+
+    def _feasible(self, planes_np, harena, lane: int) -> bool:
+        from ..core.state.constraints import Constraints
+        from ..exceptions import SolverTimeOutException
+        from ..support.model import get_model
+
+        ctx = self.contexts[self.lane_ctx[lane]]
+        constraints = Constraints(
+            list(ctx.template.world_state.constraints)
+            + self._cond_bools(planes_np, harena, lane))
+        try:
+            get_model(tuple(constraints.get_all_constraints()))
+            return True
+        except SolverTimeOutException:
+            # budget exhaustion is NOT infeasibility (it subclasses
+            # UnsatError): keep the lane, the host re-checks at issue time
+            return True
+        except UnsatError:
+            return False
+        except Exception:
+            return True  # any other solver trouble: keep exploring
+
+    # -- materialization ---------------------------------------------------------------
+
+    def _materialize(self, state: StateBatch, planes, harena, lane: int):
+        state_np = {field: np.asarray(getattr(state, field)[lane])[None]
+                    for field in state._fields}
+        planes_np = {field: np.asarray(getattr(planes, field)[lane])[None]
+                     for field in planes._fields}
+        self._materialize_np(state_np, planes_np, harena, 0,
+                             real_lane=lane)
+
+    def _materialize_np(self, state_np, planes_np, harena, lane: int,
+                        status_override=None, real_lane: Optional[int] = None):
+        from . import words
+        from ..smt import BitVec
+
+        ctx = self.contexts[self.lane_ctx[real_lane
+                                          if real_lane is not None else lane]]
+        template = ctx.template
+        global_state = copy(template)
+        mstate = global_state.mstate
+
+        # program counter: byte offset -> instruction index
+        byte_pc = int(state_np["pc"][lane])
+        disassembly = global_state.environment.code
+        index = disassembly.index_of_address(byte_pc)
+        if index is None:
+            if byte_pc >= int(state_np["code_len"][lane]):
+                # running off the code end: the host's fetch treats an
+                # out-of-range pc as STOP (core/svm.py execute_state)
+                index = len(disassembly.instruction_list)
+            else:
+                log.warning("materialize: pc %d not on an instruction "
+                            "boundary", byte_pc)
+                return
+        mstate.pc = index
+
+        # stack
+        sp = int(state_np["sp"][lane])
+        mstate.stack.clear()
+        for slot in range(sp):
+            node = int(planes_np["stack_sym"][lane, slot])
+            if node:
+                mstate.stack.append(harena.to_term(node, ctx))
+            else:
+                value = int(words.to_ints(state_np["stack"][lane, slot]))
+                mstate.stack.append(symbol_factory.BitVecVal(value, 256))
+
+        # memory
+        msize = int(state_np["msize"][lane])
+        if msize:
+            mstate.mem_extend(0, msize)
+            mem = state_np["memory"][lane]
+            mem_sym = planes_np["mem_sym"][lane]
+            from ..smt import Extract
+
+            for offset in range(msize):
+                marker = int(mem_sym[offset])
+                if marker:
+                    node, byte_index = marker >> 5, marker & 31
+                    word = harena.to_term(node, ctx)
+                    high = 255 - 8 * byte_index
+                    mstate.memory[offset] = Extract(high, high - 7, word)
+                elif mem[offset]:
+                    mstate.memory[offset] = symbol_factory.BitVecVal(
+                        int(mem[offset]), 8)
+
+        # storage writes made on device
+        account = global_state.environment.active_account
+        used = state_np["storage_used"][lane]
+        for slot in range(used.shape[0]):
+            if not used[slot]:
+                continue
+            key = int(words.to_ints(state_np["storage_keys"][lane, slot]))
+            node = int(planes_np["storage_sym"][lane, slot])
+            if node:
+                value = harena.to_term(node, ctx)
+            else:
+                value = symbol_factory.BitVecVal(
+                    int(words.to_ints(state_np["storage_vals"][lane, slot])),
+                    256)
+            account.storage[symbol_factory.BitVecVal(key, 256)] = value
+
+        # path conditions
+        for condition in self._cond_bools(planes_np, harena, lane):
+            global_state.world_state.constraints.append(condition)
+
+        # gas accounting (device tracks the lower-bound model)
+        gas_used = int(state_np["gas_used"][lane])
+        mstate.min_gas_used += gas_used
+        mstate.max_gas_used += gas_used
+
+        self.materialized += 1
+        if getattr(self.laser, "requires_statespace", False) and \
+                global_state.node is None:
+            global_state.node = template.node
+        self.laser.work_list.append(global_state)
+
+    def _hand_over_running(self, state: StateBatch, planes) -> None:
+        status = np.asarray(state.status)
+        harena = A.HostArena(self.arena)
+        for lane in np.nonzero((status == RUNNING) | (status == FORKING))[0]:
+            self._materialize(state, planes, harena, int(lane))
+
+
+def execute_message_call_tpu(laser_evm, callee_address) -> None:
+    """Drop-in for core/transaction/symbolic.py execute_message_call: seed the
+    device frontier from every open state, explore, and drain the escaped
+    states through the host engine (detectors run there unchanged)."""
+    from ..core.transaction.symbolic import ACTORS
+    from ..core.state.calldata import SymbolicCalldata
+    from ..core.transaction.transaction_models import (
+        MessageCallTransaction, get_next_transaction_id)
+    from ..smt import Or
+
+    open_states = laser_evm.open_states[:]
+    del laser_evm.open_states[:]
+    seeds: List[GlobalState] = []
+    for open_world_state in open_states:
+        if open_world_state[callee_address].deleted:
+            continue
+        next_transaction_id = get_next_transaction_id()
+        external_sender = symbol_factory.BitVecSym(
+            f"sender_{next_transaction_id}", 256)
+        calldata = SymbolicCalldata(next_transaction_id)
+        transaction = MessageCallTransaction(
+            world_state=open_world_state,
+            identifier=next_transaction_id,
+            gas_price=symbol_factory.BitVecSym(
+                f"gas_price{next_transaction_id}", 256),
+            gas_limit=8000000,
+            origin=external_sender,
+            caller=external_sender,
+            callee_account=open_world_state[callee_address],
+            call_data=calldata,
+            call_value=symbol_factory.BitVecSym(
+                f"call_value{next_transaction_id}", 256),
+        )
+        template = transaction.initial_global_state()
+        template.transaction_stack.append((transaction, None))
+        template.world_state.constraints.append(
+            Or(*[transaction.caller == actor
+                 for actor in ACTORS.addresses.values()]))
+        if getattr(laser_evm, "requires_statespace", False):
+            laser_evm.new_node_for_transaction(template, transaction)
+        seeds.append(template)
+
+    if not seeds:
+        laser_evm.exec()
+        return
+
+    import os
+
+    lane_budget = int(os.environ.get("MYTHRIL_TPU_LANES", DEFAULT_LANES))
+    frontier = _Frontier(laser_evm,
+                         n_lanes=max(lane_budget, 2 * len(seeds)))
+    seeded = frontier.seed(seeds)
+    if seeded is None:
+        log.info("frontier: storage not device-representable; host fallback")
+        for template in seeds:
+            laser_evm.work_list.append(template)
+        laser_evm.exec()
+        return
+
+    state, planes = seeded
+    frontier.run(state, planes)
+    log.info("frontier: %d forks, %d infeasible pruned, %d states "
+             "materialized for the host (arena nodes: %d)", frontier.forks,
+             frontier.infeasible, frontier.materialized, int(frontier.arena.n))
+    # cumulative counters for benchmarking/diagnostics (bench.py)
+    laser_evm.frontier_lane_steps = getattr(
+        laser_evm, "frontier_lane_steps", 0) + frontier.lane_steps
+    laser_evm.frontier_forks = getattr(
+        laser_evm, "frontier_forks", 0) + frontier.forks
+    laser_evm.exec()

@@ -29,8 +29,8 @@ import numpy as np
 
 from ..ops.opcodes import ADDRESS, GAS, OPCODES, STACK
 from . import keccak, words
-from .batch import (ERRORED, ESCAPED, RETURNED, REVERTED, RUNNING, STOPPED,
-                    StateBatch)
+from .batch import (ERRORED, ESCAPED, FORKING, RETURNED, REVERTED, RUNNING,
+                    STOPPED, StateBatch)
 
 I32 = jnp.int32
 I64 = jnp.int64
@@ -156,11 +156,18 @@ def _table_set(keys, vals, used, lane_mask, key, value):
     return keys, vals, used, full
 
 
-def step(state: StateBatch) -> StateBatch:
-    """Advance every running lane by one instruction."""
+def step(state: StateBatch, force_escape=None, force_fork=None) -> StateBatch:
+    """Advance every running lane by one instruction.
+
+    `force_escape` / `force_fork` (bool[B], optional) are the symbolic
+    frontier's pre-pass decisions (parallel/symstep.py): lanes forced out
+    take NO concrete effects from this step — an escaping lane must reach the
+    host exactly as it stood before the instruction it cannot execute."""
     batch, slots = state.stack.shape[0], state.stack.shape[1]
     mem_cap = state.memory.shape[1]
     running = state.status == RUNNING
+    if force_escape is not None:
+        running = running & ~force_escape & ~force_fork
     lane = jnp.arange(batch)
 
     # ---- fetch ----------------------------------------------------------------------
@@ -559,6 +566,17 @@ def step(state: StateBatch) -> StateBatch:
             mask = mask[..., None]
         return jnp.where(mask, new, old)
 
+    if force_escape is not None:
+        # forced-out lanes keep all their state; only the status moves
+        was_running = state.status == RUNNING
+        forced_status = jnp.where(
+            was_running & force_fork, FORKING,
+            jnp.where(was_running & force_escape, ESCAPED, state.status))
+        merge_status = lambda new, old: jnp.where(  # noqa: E731
+            running, new, forced_status)
+    else:
+        merge_status = merge
+
     def merge_adv(new, old):
         mask = running & advanced
         while mask.ndim < new.ndim:
@@ -571,7 +589,7 @@ def step(state: StateBatch) -> StateBatch:
         pc=merge_adv(next_pc, state.pc),
         gas_used=merge_adv(new_gas_used, state.gas_used),
         gas_limit=state.gas_limit,
-        status=merge(new_status, state.status),
+        status=merge_status(new_status, state.status),
         memory=merge_adv(new_memory, state.memory),
         msize=merge_adv(new_msize, state.msize),
         code=state.code,

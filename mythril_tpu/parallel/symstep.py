@@ -1,0 +1,395 @@
+"""Symbolic lockstep: the batched interpreter stepping SYMBOLIC words.
+
+This replaces the reference hot loop (mythril/laser/ethereum/svm.py:325-401 —
+one Python GlobalState per instruction, JUMPI forking via deepcopy at
+instructions.py:1633,1658) with a vmapped frontier: symbolic words live as
+int32 arena node ids riding in planes parallel to the concrete StateBatch
+(SymPlanes), new expressions are scatter-allocated arena rows
+(parallel/arena.py), and a symbolic JUMPI pauses the lane (status=FORKING)
+for the driver to duplicate — fork = lane copy + one constraint id per side,
+never a deepcopy.
+
+Division of labor per step:
+  1. `_decide` (pre-pass): fetch each lane's opcode, look at which operands
+     are symbolic, and classify — device-representable (arith/cmp/bitwise/
+     memory round-trips/storage with concrete keys), FORK (symbolic JUMPI
+     condition), or ESCAPE (CALL family, keccak over symbolic bytes, symbolic
+     offsets/keys — everything the host oracle owns).
+  2. `lockstep.step(state, force_escape, force_fork)` executes the concrete
+     semantics; forced-out lanes take no effects.
+  3. `_apply_sym_effects` (post-pass): allocate arena nodes for symbolic
+     results and mirror the stack/memory/storage effects onto the planes.
+
+Lanes escape exactly AT the instruction they cannot execute, so the host
+engine (and its detector hooks) resumes them with full fidelity
+(parallel/frontier.py materializes the GlobalState)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import arena as A
+from . import lockstep
+from .batch import DEAD, FORKING, RUNNING, StateBatch
+
+I32 = jnp.int32
+
+O = lockstep.O
+POPS_T = lockstep.POPS_T
+
+# ops whose result is representable as an arena node when operands are
+# symbolic (everything else with a symbolic operand escapes or forks)
+_SYM_OK = np.zeros(256, dtype=bool)
+for _name in ["ADD", "MUL", "SUB", "DIV", "SDIV", "MOD", "SMOD",
+              "LT", "GT", "SLT", "SGT", "EQ", "ISZERO", "AND", "OR", "XOR",
+              "NOT", "BYTE", "SHL", "SHR", "SAR"]:
+    _SYM_OK[O[_name]] = True
+# SIGNEXTEND deliberately absent: a symbolic size needs the host's 31-way
+# If-chain (instructions.py); with a symbolic operand the lane escapes
+SYM_OK_T = jnp.asarray(_SYM_OK)
+
+# ops that never need symbolic handling: stack shuffling and constants flow
+# the plane through _sym_stack_update instead
+_PLUMBING = np.zeros(256, dtype=bool)
+for _byte in range(0x5F, 0xA0):  # PUSH0-32, DUP1-16, SWAP1-16
+    _PLUMBING[_byte] = True
+_PLUMBING[O["POP"]] = True
+_PLUMBING[O["JUMPDEST"]] = True
+_PLUMBING[O["JUMP"]] = True
+_PLUMBING[O["JUMPI"]] = True
+_PLUMBING[O["PC"]] = True
+_PLUMBING[O["MSIZE"]] = True
+_PLUMBING[O["GAS"]] = True
+_PLUMBING[O["STOP"]] = True
+PLUMBING_T = jnp.asarray(_PLUMBING)
+
+#: env opcode byte -> arena var class (symbolic-env lanes)
+_ENV_CLASS = np.zeros(256, dtype=np.int32)
+for _name, _cls in [("CALLER", A.V_CALLER), ("ORIGIN", A.V_ORIGIN),
+                    ("CALLVALUE", A.V_CALLVALUE), ("GASPRICE", A.V_GASPRICE),
+                    ("TIMESTAMP", A.V_TIMESTAMP), ("NUMBER", A.V_NUMBER),
+                    ("COINBASE", A.V_COINBASE),
+                    ("PREVRANDAO", A.V_PREVRANDAO),
+                    ("BASEFEE", A.V_BASEFEE),
+                    ("CALLDATASIZE", A.V_CALLDATASIZE)]:
+    _ENV_CLASS[O[_name]] = _cls
+ENV_CLASS_T = jnp.asarray(_ENV_CLASS)
+
+
+class SymPlanes(NamedTuple):
+    """Symbolic shadow of the concrete StateBatch (0 = concrete everywhere)."""
+
+    stack_sym: jnp.ndarray     # int32[B, S] arena node per stack slot
+    mem_sym: jnp.ndarray       # int32[B, M] (node << 5 | byte_index), 0=concrete
+    storage_sym: jnp.ndarray   # int32[B, K] arena node per storage slot value
+    conds: jnp.ndarray         # int32[B, KC] signed node ids (neg = negated)
+    cond_count: jnp.ndarray    # int32[B]
+    fork_cond: jnp.ndarray     # int32[B] node id pending at a FORKING lane
+    symbolic_env: jnp.ndarray  # bool[B] env/calldata are symbolic
+
+    @classmethod
+    def empty(cls, batch: int, stack_slots: int, mem_bytes: int,
+              storage_slots: int, max_conds: int = 64) -> "SymPlanes":
+        return cls(
+            stack_sym=jnp.zeros((batch, stack_slots), dtype=I32),
+            mem_sym=jnp.zeros((batch, mem_bytes), dtype=I32),
+            storage_sym=jnp.zeros((batch, storage_slots), dtype=I32),
+            conds=jnp.zeros((batch, max_conds), dtype=I32),
+            cond_count=jnp.zeros(batch, dtype=I32),
+            fork_cond=jnp.zeros(batch, dtype=I32),
+            symbolic_env=jnp.ones(batch, dtype=bool),
+        )
+
+
+def _operand_syms(state: StateBatch, planes: SymPlanes, n: int):
+    """Arena node of the n-th-from-top stack slot (0 where concrete)."""
+    idx = jnp.clip(state.sp - n, 0, planes.stack_sym.shape[1] - 1)
+    return jnp.take_along_axis(planes.stack_sym, idx[:, None].astype(I32),
+                               axis=1)[:, 0]
+
+
+def _range_has_sym(plane_row_any, off, size, cap):
+    """bool[B]: any symbolic byte in [off, off+size) of mem_sym."""
+    j = jnp.arange(cap)
+    in_range = (j[None, :] >= off[:, None]) & (j[None, :] < (off + size)[:, None])
+    return jnp.any(in_range & (plane_row_any != 0), axis=1)
+
+
+def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena
+             ) -> Tuple[StateBatch, SymPlanes, A.Arena]:
+    """One symbolic lockstep step for the whole batch."""
+    batch, slots = planes.stack_sym.shape
+    mem_cap = planes.mem_sym.shape[1]
+    lane = jnp.arange(batch)
+    running = state.status == RUNNING
+
+    # ---- fetch (same as lockstep) ---------------------------------------------------
+    in_code = state.pc < state.code_len
+    op = jnp.where(
+        in_code,
+        jnp.take_along_axis(state.code,
+                            jnp.clip(state.pc, 0, state.code.shape[1] - 1)
+                            [:, None], axis=1)[:, 0].astype(I32),
+        I32(O["STOP"]))
+
+    def is_op(name):
+        return op == O[name]
+
+    sym1 = _operand_syms(state, planes, 1)
+    sym2 = _operand_syms(state, planes, 2)
+    sym3 = _operand_syms(state, planes, 3)
+    pops = POPS_T[op]
+    has1 = (pops >= 1) & (sym1 != 0)
+    has2 = (pops >= 2) & (sym2 != 0)
+    has3 = (pops >= 3) & (sym3 != 0)
+    any_operand_sym = has1 | has2 | has3
+
+    a_limbs = lockstep._peek(state, 1)
+    b_limbs = lockstep._peek(state, 2)
+
+    off_i, off_fits = lockstep._word_to_i64(a_limbs)
+
+    symbolic_env = planes.symbolic_env
+    env_class = ENV_CLASS_T[op]
+    env_var_op = running & symbolic_env & (env_class != 0)
+    cdl_op = running & symbolic_env & is_op("CALLDATALOAD")
+    cdl_sym_off = cdl_op & (sym1 != 0)
+    cdl_var = cdl_op & (sym1 == 0) & off_fits & (off_i < (1 << 30))
+
+    # memory round-trip classification
+    mstore_sym_val = running & is_op("MSTORE") & (sym1 == 0) & (sym2 != 0)
+    mload_mask = running & is_op("MLOAD") & (sym1 == 0)
+    mload_first = jnp.take_along_axis(
+        planes.mem_sym, jnp.clip(off_i, 0, mem_cap - 1).astype(I32)[:, None],
+        axis=1)[:, 0]
+    j32 = jnp.arange(32)
+    mload_idx = jnp.clip(off_i[:, None] + j32, 0, mem_cap - 1).astype(I32)
+    mload_cells = jnp.take_along_axis(planes.mem_sym, mload_idx, axis=1)
+    mload_any_sym = jnp.any(mload_cells != 0, axis=1)
+    # the clean round-trip: 32 cells hold (node, 0..31) in order
+    expected = jnp.where((mload_first != 0)[:, None],
+                         ((mload_first >> 5) << 5)[:, None] + j32[None, :], 0)
+    mload_clean = mload_any_sym & (mload_first != 0) \
+        & ((mload_first & 31) == 0) & jnp.all(mload_cells == expected, axis=1)
+    mload_node = jnp.where(mload_clean, mload_first >> 5, 0)
+    mload_dirty = mload_mask & mload_any_sym & ~mload_clean
+
+    # storage
+    sload_mask = running & is_op("SLOAD")
+    sstore_mask = running & is_op("SSTORE")
+    storage_match = state.storage_used & jnp.all(
+        state.storage_keys == a_limbs[:, None, :], axis=-1)
+    storage_found = jnp.any(storage_match, axis=-1)
+    storage_slot = jnp.argmax(storage_match, axis=-1)
+    sload_node = jnp.where(
+        sload_mask & storage_found,
+        planes.storage_sym[lane, storage_slot], 0)
+
+    # ---- classify: FORK -------------------------------------------------------------
+    jumpi_sym_cond = running & is_op("JUMPI") & (sym2 != 0) & (sym1 == 0)
+    force_fork = jumpi_sym_cond
+
+    # ---- classify: ESCAPE -----------------------------------------------------------
+    sym_representable = SYM_OK_T[op] | PLUMBING_T[op]
+    # transaction-end opcodes ALWAYS go to the host in symbolic mode: the
+    # TransactionEndSignal machinery (open-state add, potential-issue checks)
+    # and the exceptions detector's INVALID hook live there
+    esc_always = running & (is_op("STOP") | is_op("RETURN") | is_op("REVERT")
+                            | is_op("INVALID"))
+    # symbolic operand feeding an op the device cannot represent
+    esc = any_operand_sym & ~sym_representable & ~mstore_sym_val \
+        & ~(sload_mask | sstore_mask)
+    # memory ops with symbolic offsets/sizes
+    esc = esc | (running & is_op("JUMP") & (sym1 != 0))
+    esc = esc | (running & is_op("JUMPI") & (sym1 != 0))   # symbolic dest
+    esc = esc | (running & is_op("MSTORE") & (sym1 != 0))
+    esc = esc | (running & is_op("MLOAD") & (sym1 != 0))
+    esc = esc | cdl_sym_off
+    esc = esc | mload_dirty
+    # storage with symbolic key
+    esc = esc | ((sload_mask | sstore_mask) & (sym1 != 0))
+    # SHA3 / RETURN / REVERT over symbolic memory bytes go to the host (the
+    # keccak function manager and return-data semantics live there)
+    size_for_read = jnp.where(is_op("SHA3") | is_op("RETURN")
+                              | is_op("REVERT"),
+                              lockstep._word_to_i64(b_limbs)[0], 0)
+    mem_region_sym = _range_has_sym(planes.mem_sym, off_i,
+                                    jnp.clip(size_for_read, 0, mem_cap),
+                                    mem_cap)
+    esc = esc | (running & (is_op("SHA3") | is_op("RETURN") | is_op("REVERT"))
+                 & (sym1 == 0) & (sym2 == 0) & mem_region_sym)
+    # symbolic-calldata lanes cannot run byte-copies from calldata, and
+    # balances are symbolic arrays only the host models
+    esc = esc | (running & symbolic_env & is_op("CALLDATACOPY"))
+    esc = esc | (running & symbolic_env & is_op("SELFBALANCE"))
+    # concrete copies landing on symbolically-marked bytes would need the
+    # marks cleared byte-accurately; hand those to the host instead
+    copy_size_i = lockstep._word_to_i64(
+        lockstep._peek(state, 3))[0]
+    esc = esc | (running & (is_op("CODECOPY") | is_op("RETURNDATACOPY"))
+                 & _range_has_sym(planes.mem_sym, off_i,
+                                  jnp.clip(copy_size_i, 0, mem_cap), mem_cap))
+    # MCOPY with any symbolic memory in the lane (byte-accurate plane moves
+    # are not worth the complexity at this tier)
+    esc = esc | (running & is_op("MCOPY")
+                 & jnp.any(planes.mem_sym != 0, axis=1))
+    force_escape = (esc | esc_always) & ~force_fork
+
+    # ---- concrete semantics (forced-out lanes untouched) ----------------------------
+    new_state = lockstep.step(state, force_escape=force_escape,
+                              force_fork=force_fork)
+
+    # ---- allocate nodes -------------------------------------------------------------
+    advanced = running & ~force_escape & ~force_fork \
+        & (new_state.status == RUNNING)
+
+    # const wraps for concrete operands of symbolic ops
+    sym_compute = advanced & any_operand_sym & SYM_OK_T[op]
+    need_const_a = sym_compute & (sym1 == 0) & (pops >= 1)
+    arena, const_a, ovf_a = A.alloc_consts(arena, need_const_a, a_limbs)
+    need_const_b = sym_compute & (sym2 == 0) & (pops >= 2)
+    arena, const_b, ovf_b = A.alloc_consts(arena, need_const_b, b_limbs)
+    node_a = jnp.where(sym1 != 0, sym1, const_a)
+    node_b = jnp.where(sym2 != 0, sym2, const_b)
+
+    # MSTORE of a symbolic value: value node is operand 2
+    # SSTORE of a symbolic value with concrete key: store node directly
+    sstore_sym_val = advanced & sstore_mask & (sym1 == 0) & (sym2 != 0)
+
+    # result nodes for computations
+    arena, result_node, ovf_r = A.alloc_rows(
+        arena, sym_compute, op, node_a, node_b, jnp.zeros_like(node_a),
+        jnp.zeros_like(node_a), jnp.zeros_like(node_a))
+
+    # env var nodes
+    env_alloc = advanced & (env_var_op | cdl_var)
+    var_class = jnp.where(cdl_var, A.V_CALLDATA_WORD, env_class)
+    var_qual = jnp.where(cdl_var, off_i.astype(I32), 0)
+    arena, env_node, ovf_e = A.alloc_rows(
+        arena, env_alloc, jnp.full_like(op, A.VAR), jnp.zeros_like(op),
+        jnp.zeros_like(op), jnp.zeros_like(op), var_class, var_qual)
+
+    overflow = ovf_a | ovf_b | ovf_r | ovf_e
+    # arena exhaustion: the state already advanced with a zero (=concrete)
+    # node, which would silently corrupt — kill the lane. The driver keeps
+    # head-room per chunk (frontier.ARENA_HEADROOM) so this is a last-resort
+    # guard, and killed lanes are counted, never silent.
+    new_state = new_state._replace(
+        status=jnp.where(overflow, DEAD, new_state.status))
+
+    # ---- mirror plane effects -------------------------------------------------------
+    new_top_node = jnp.where(sym_compute, result_node,
+                             jnp.where(env_alloc, env_node,
+                                       jnp.where(mload_mask & mload_clean,
+                                                 mload_node, sload_node)))
+
+    new_planes = _sym_stack_update(state, new_state, planes, op, advanced,
+                                   new_top_node)
+
+    # MSTORE symbolic value: mark 32 bytes (node<<5 | byte_index)
+    mstore_adv = advanced & mstore_sym_val
+    mem_sym = new_planes.mem_sym
+    write_idx = jnp.where(mstore_adv[:, None],
+                          jnp.clip(off_i[:, None] + j32, 0, mem_cap - 1),
+                          mem_cap).astype(I32)
+    mem_sym = mem_sym.at[lane[:, None], write_idx].set(
+        jnp.where(mstore_adv[:, None], (sym2[:, None] << 5) + j32[None, :], 0),
+        mode="drop")
+    # concrete MSTORE over previously-symbolic bytes clears the marks
+    mstore_concrete = advanced & is_op("MSTORE") & (sym1 == 0) & (sym2 == 0)
+    clear_idx = jnp.where(mstore_concrete[:, None],
+                          jnp.clip(off_i[:, None] + j32, 0, mem_cap - 1),
+                          mem_cap).astype(I32)
+    mem_sym = mem_sym.at[lane[:, None], clear_idx].set(0, mode="drop")
+    # concrete MSTORE8 clears its single byte's mark (a stale mark would let
+    # a later MLOAD resurrect the overwritten symbolic word)
+    mstore8_concrete = advanced & is_op("MSTORE8") & (sym1 == 0) & (sym2 == 0)
+    clear8_idx = jnp.where(mstore8_concrete,
+                           jnp.clip(off_i, 0, mem_cap - 1),
+                           mem_cap).astype(I32)
+    mem_sym = mem_sym.at[lane, clear8_idx].set(0, mode="drop")
+
+    # storage plane: symbolic SSTORE sets the slot's node, concrete clears it
+    storage_sym = new_planes.storage_sym
+    new_match = new_state.storage_used & jnp.all(
+        new_state.storage_keys == a_limbs[:, None, :], axis=-1)
+    new_slot = jnp.argmax(new_match, axis=-1)
+    sstore_any = advanced & sstore_mask & (sym1 == 0) \
+        & jnp.any(new_match, axis=-1)
+    storage_sym = storage_sym.at[
+        jnp.where(sstore_any, lane, batch),
+        jnp.where(sstore_any, new_slot, 0)].set(
+        jnp.where(sstore_any, sym2, 0), mode="drop")
+
+    # fork condition for paused lanes
+    fork_cond = jnp.where((state.status == RUNNING) & force_fork, sym2,
+                          new_planes.fork_cond)
+
+    new_planes = new_planes._replace(mem_sym=mem_sym,
+                                     storage_sym=storage_sym,
+                                     fork_cond=fork_cond)
+    return new_state, new_planes, arena
+
+
+def _sym_stack_update(state: StateBatch, new_state: StateBatch,
+                      planes: SymPlanes, op, advanced, new_top_node
+                      ) -> SymPlanes:
+    """Mirror the concrete stack effect onto the node plane: drop pops, keep
+    the tail, write the produced node (or 0) at the new top; DUP copies the
+    source slot's node; SWAP exchanges two nodes."""
+    batch, slots = planes.stack_sym.shape
+    lane = jnp.arange(batch)
+    stack_sym = planes.stack_sym
+
+    is_dup = (op >= 0x80) & (op <= 0x8F)
+    is_swap = (op >= 0x90) & (op <= 0x9F)
+    pushes = lockstep.PUSHES_T[op]
+    writes_result = (pushes >= 1) & ~is_swap
+
+    dup_n = jnp.clip(op - 0x7F, 1, 16)
+    dup_src = jnp.clip(state.sp - dup_n, 0, slots - 1)
+    dup_node = stack_sym[lane, dup_src]
+
+    top_value = jnp.where(is_dup, dup_node, new_top_node)
+    write_idx = jnp.clip(new_state.sp - 1, 0, slots - 1)
+    do_write = advanced & writes_result
+    stack_sym = stack_sym.at[jnp.where(do_write, lane, batch),
+                             write_idx].set(
+        jnp.where(do_write, top_value, 0), mode="drop")
+
+    # slots above the new sp are dead: clear so stale nodes never resurface
+    j = jnp.arange(slots)[None, :]
+    above = advanced[:, None] & (j >= new_state.sp[:, None])
+    stack_sym = jnp.where(above, 0, stack_sym)
+
+    # SWAPn exchanges (sp-1) and (sp-1-n)
+    swap_n = jnp.clip(op - 0x8F, 1, 16)
+    swap_do = advanced & is_swap
+    top_idx = jnp.clip(state.sp - 1, 0, slots - 1)
+    deep_idx = jnp.clip(state.sp - 1 - swap_n, 0, slots - 1)
+    top_node = stack_sym[lane, top_idx]
+    deep_node = stack_sym[lane, deep_idx]
+    stack_sym = stack_sym.at[jnp.where(swap_do, lane, batch),
+                             top_idx].set(
+        jnp.where(swap_do, deep_node, 0), mode="drop")
+    stack_sym = stack_sym.at[jnp.where(swap_do, lane, batch),
+                             deep_idx].set(
+        jnp.where(swap_do, top_node, 0), mode="drop")
+
+    return planes._replace(stack_sym=stack_sym)
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def sym_step_many(state: StateBatch, planes: SymPlanes, arena: A.Arena,
+                  n_steps: int):
+    """n_steps fused symbolic steps (stops forking lanes immediately: a
+    FORKING status freezes the lane until the driver services it)."""
+    def body(_, carry):
+        return sym_step(*carry)
+
+    return jax.lax.fori_loop(0, n_steps, body, (state, planes, arena))

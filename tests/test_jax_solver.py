@@ -103,12 +103,18 @@ def test_clause_cap_returns_unknown():
 def test_device_failure_falls_back_to_cdcl(monkeypatch):
     """VERDICT r2 weak #1: a TPU-side failure silently produced a clean
     report. The seam must catch, count, and re-solve on the CDCL core."""
+    from mythril_tpu.smt.solver import solver as solver_module
+    from mythril_tpu.smt.solver.incremental import IncrementalPipeline
     from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
 
     def boom(*a, **k):
         raise RuntimeError("TPU worker process crashed")
 
     monkeypatch.setattr(jax_solver, "solve_cnf_device", boom)
+    # a fresh pipeline: the process-wide pool may exceed the device clause
+    # cap (the seam then skips the device entirely and never hits the crash)
+    if sat.have_native():
+        monkeypatch.setattr(solver_module, "_pipeline", IncrementalPipeline())
     stats = SolverStatistics()
     before = stats.device_fallbacks
     a = symbol_factory.BitVecSym("fb", 32)
