@@ -185,7 +185,22 @@ class _Frontier:
         from ..core.time_handler import time_handler
 
         max_steps = int(os.environ.get("MYTHRIL_TPU_MAX_STEPS", MAX_STEPS))
+        checkpoint_path = os.environ.get("MYTHRIL_TPU_CHECKPOINT")
+        resume_path = os.environ.get("MYTHRIL_TPU_RESUME")
+        if resume_path:
+            if not resume_path.endswith(".npz"):
+                resume_path += ".npz"
+            if os.path.exists(resume_path):
+                try:
+                    state, planes = self.load_checkpoint(resume_path)
+                    log.info("resumed frontier from %s (%d forks so far)",
+                             resume_path, self.forks)
+                except Exception as error:  # corrupt file / identity mismatch
+                    log.warning("cannot resume from %s (%s); starting the "
+                                "device phase fresh", resume_path, error)
+                os.environ.pop("MYTHRIL_TPU_RESUME", None)  # consume once
         steps = 0
+        services = 0
         while steps < max_steps:
             if int(self.arena.n) > self.arena.capacity - ARENA_HEADROOM:
                 log.warning("arena head-room exhausted; handing remaining "
@@ -208,6 +223,9 @@ class _Frontier:
                     or not (status == RUNNING).any():
                 state, planes = self._service(state, planes)
                 status = np.asarray(state.status)
+                services += 1
+                if checkpoint_path and services % 8 == 0:
+                    self.save_checkpoint(checkpoint_path, state, planes)
             if not ((status == RUNNING) | (status == FORKING)).any():
                 return
         # budget exhausted: surviving lanes continue on host
@@ -425,6 +443,82 @@ class _Frontier:
                 global_state.node is None:
             global_state.node = template.node
         self.laser.work_list.append(global_state)
+
+    # -- checkpointing -----------------------------------------------------------------
+
+    def save_checkpoint(self, path: str, state: StateBatch,
+                        planes: symstep.SymPlanes) -> None:
+        """Dense-array frontier checkpoint (SURVEY §5: 'dense arrays
+        serialize trivially'): one .npz holding the device phase —
+        StateBatch planes, symbolic planes, the USED prefix of the
+        expression arena, and lane bookkeeping. Written atomically
+        (tmp + os.replace) so preemption mid-write never corrupts the only
+        checkpoint. Scope: the device phase only — states already
+        materialized onto the host worklist are drained by the host
+        continuation and are not re-created on resume."""
+        if not path.endswith(".npz"):
+            path += ".npz"  # np.savez appends it; keep save/resume agreeing
+        arrays = {}
+        for field in state._fields:
+            arrays[f"state_{field}"] = np.asarray(getattr(state, field))
+        for field in planes._fields:
+            arrays[f"planes_{field}"] = np.asarray(getattr(planes, field))
+        used = int(self.arena.n)
+        used_const = int(self.arena.n_const)
+        for field in ("op", "a", "b", "c", "imm", "imm2"):
+            arrays[f"arena_{field}"] = np.asarray(
+                getattr(self.arena, field))[:used]
+        arrays["arena_const_vals"] = np.asarray(
+            self.arena.const_vals)[:used_const]
+        arrays["arena_caps"] = np.asarray(
+            [self.arena.capacity, self.arena.const_vals.shape[0],
+             used, used_const])
+        arrays["lane_ctx"] = self.lane_ctx
+        arrays["counters"] = np.asarray(
+            [self.forks, self.infeasible, self.materialized, self.lane_steps])
+        arrays["identity"] = np.asarray(
+            [self.n_lanes, len(self.contexts)])
+        import os
+
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as handle:
+            np.savez_compressed(handle, **arrays)
+        os.replace(tmp, path)
+
+    def load_checkpoint(self, path: str):
+        """Restore (state, planes) saved by save_checkpoint; the arena and
+        counters are restored onto this frontier in place. Raises ValueError
+        on an identity mismatch (checkpoint from a different seeding)."""
+        if not path.endswith(".npz"):
+            path += ".npz"
+        data = np.load(path)
+        n_lanes, n_contexts = (int(v) for v in data["identity"])
+        if n_lanes != self.n_lanes or n_contexts != len(self.contexts):
+            raise ValueError(
+                f"checkpoint identity mismatch: saved {n_lanes} lanes / "
+                f"{n_contexts} contexts, this frontier has {self.n_lanes} / "
+                f"{len(self.contexts)}")
+        state = StateBatch(**{f: data[f"state_{f}"]
+                              for f in StateBatch._fields})
+        planes = symstep.SymPlanes(**{f: data[f"planes_{f}"]
+                                      for f in symstep.SymPlanes._fields})
+        cap, const_cap, used, used_const = (int(v)
+                                            for v in data["arena_caps"])
+        arena = A.new_arena(capacity=cap, const_capacity=const_cap)
+        fields = {}
+        for field in ("op", "a", "b", "c", "imm", "imm2"):
+            full = np.zeros(cap, dtype=np.int32)
+            full[:used] = data[f"arena_{field}"]
+            fields[field] = full
+        const_vals = np.zeros_like(np.asarray(arena.const_vals))
+        const_vals[:used_const] = data["arena_const_vals"]
+        self.arena = arena._replace(
+            n=np.int32(used), n_const=np.int32(used_const),
+            const_vals=const_vals, **fields)
+        self.lane_ctx = data["lane_ctx"]
+        self.forks, self.infeasible, self.materialized, self.lane_steps = (
+            int(v) for v in data["counters"])
+        return state, planes
 
     def _hand_over_running(self, state: StateBatch, planes) -> None:
         status = np.asarray(state.status)

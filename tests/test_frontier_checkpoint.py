@@ -1,0 +1,47 @@
+"""Frontier checkpoint/resume (SURVEY §5: the dense-array frontier
+serializes trivially; a preempted device phase must continue identically)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_checkpoint_roundtrip_and_identical_continuation(tmp_path):
+    import __graft_entry__ as graft
+    from mythril_tpu.parallel import arena as parena
+    from mythril_tpu.parallel import symstep
+    from mythril_tpu.parallel.frontier import _Frontier
+
+    n_lanes = 8
+    state, planes = graft._symbolic_batch(n_lanes)
+    frontier = _Frontier(laser_evm=None, n_lanes=n_lanes)
+    frontier.arena = parena.new_arena(capacity=1 << 10,
+                                      const_capacity=1 << 6)
+
+    # advance a few chunks, then checkpoint mid-flight
+    state, planes, frontier.arena = symstep.sym_step_many(
+        state, planes, frontier.arena, 4)
+    frontier.forks = 3
+    frontier.lane_steps = 123
+    path = str(tmp_path / "frontier.npz")
+    frontier.save_checkpoint(path, state, planes)
+
+    restored = _Frontier(laser_evm=None, n_lanes=n_lanes)
+    r_state, r_planes = restored.load_checkpoint(path)
+    assert restored.forks == 3 and restored.lane_steps == 123
+    assert int(restored.arena.n) == int(frontier.arena.n)
+
+    # both continuations must be bit-identical
+    cont_a = symstep.sym_step_many(state, planes, frontier.arena, 4)
+    cont_b = symstep.sym_step_many(r_state, r_planes, restored.arena, 4)
+    for part_a, part_b in zip(cont_a, cont_b):
+        for name, leaf_a in zip(part_a._fields, part_a):
+            np.testing.assert_array_equal(
+                np.asarray(leaf_a), np.asarray(getattr(part_b, name)),
+                err_msg=f"continuation diverged on {name}")
