@@ -1,0 +1,9 @@
+"""`python -m mythril_tpu ...` == `myth-tpu ...` (reference parity: the
+`myth` console script, mythril setup.py:139 / myth:1-11)."""
+
+import sys
+
+from .interfaces.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -146,6 +146,8 @@ class LaserEVM:
         time_handler.start_execution(self.execution_timeout)
         self.time = datetime.now()
         predicted_hashes = self._predicted_function_hashes(address)
+        if not predicted_hashes:
+            predicted_hashes = self._cli_transaction_sequences()
         for i in range(self.transaction_count):
             if len(self.open_states) == 0:
                 log.info("no open states left, ending transaction sequence")
@@ -162,17 +164,37 @@ class LaserEVM:
                      "%d initial states", i, len(self.open_states))
             for hook in self._start_sym_trans_hooks:
                 hook()
+            hashes = (predicted_hashes[i]
+                      if i < len(predicted_hashes) else None)
             if self.engine == "tpu":
                 from ..parallel.frontier import execute_message_call_tpu
 
-                execute_message_call_tpu(self, address)
+                execute_message_call_tpu(self, address, func_hashes=hashes)
             else:
-                execute_message_call(
-                    self, address,
-                    func_hashes=(predicted_hashes[i]
-                                 if i < len(predicted_hashes) else None))
+                execute_message_call(self, address, func_hashes=hashes)
             for hook in self._stop_sym_trans_hooks:
                 hook()
+
+    @staticmethod
+    def _cli_transaction_sequences() -> List[Optional[List]]:
+        """`--transaction-sequences [[hash,...],...]`: per-tx selector
+        restriction from the CLI (reference svm.py:233,294-299 — ints become
+        4-byte selectors; -1/-2 pass through for fallback/receive)."""
+        from ..support.support_args import args
+
+        sequences = getattr(args, "transaction_sequences", None)
+        if not sequences:
+            return []
+        hashes: List[Optional[List]] = []
+        for tx_hashes in sequences:
+            if tx_hashes is None:
+                hashes.append(None)
+                continue
+            hashes.append([
+                h if h in (-1, -2)
+                else bytes.fromhex(hex(h)[2:].zfill(8))
+                for h in tx_hashes])
+        return hashes
 
     def _predicted_function_hashes(self, address) -> List[Optional[List]]:
         """Map the tx_strategy's predicted function indices to 4-byte

@@ -69,6 +69,8 @@ def generate_function_constraints(calldata: SymbolicCalldata,
             from ...smt import ULT
 
             options.append(ULT(calldata.calldatasize, 4))
+        elif func_hash == -2:  # receive function: empty calldata
+            options.append(calldata.calldatasize == 0)
         else:
             word = [calldata[i] == func_hash[i] for i in range(4)]
             from ...smt import And

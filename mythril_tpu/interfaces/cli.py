@@ -223,6 +223,18 @@ def main(argv=None) -> int:
     subparsers.add_parser("version", help="print version")
 
     cli_args = parser.parse_args(argv)
+    if getattr(cli_args, "transaction_sequences", None):
+        # "[[0xdeadbeef], [-1]]" -> nested int lists (reference cli.py:651-668;
+        # a sequence longer than -t silently extends the tx count there too)
+        from ast import literal_eval
+
+        try:
+            cli_args.transaction_sequences = literal_eval(
+                str(cli_args.transaction_sequences))
+        except (ValueError, SyntaxError):
+            parser.error("--transaction-sequences is not a valid nested list")
+        if len(cli_args.transaction_sequences) != cli_args.transaction_count:
+            cli_args.transaction_count = len(cli_args.transaction_sequences)
     logging.basicConfig(
         level=[logging.NOTSET, logging.CRITICAL, logging.ERROR,
                logging.WARNING, logging.INFO,

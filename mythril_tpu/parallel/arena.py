@@ -155,14 +155,19 @@ class HostArena:
     """Host snapshot of the arena tables + memoized term conversion."""
 
     def __init__(self, arena: Arena):
-        self.op = np.asarray(arena.op)
-        self.a = np.asarray(arena.a)
-        self.b = np.asarray(arena.b)
-        self.c = np.asarray(arena.c)
-        self.imm = np.asarray(arena.imm)
-        self.imm2 = np.asarray(arena.imm2)
-        self.n = int(arena.n)
-        self.const_vals = np.asarray(arena.const_vals)
+        # transfer only the used prefix: the arena tables are allocated at
+        # full capacity (1<<18 rows) on device, and a snapshot per service
+        # round-trip would move ~7MB through the host<->TPU tunnel each time
+        used = int(arena.n)
+        used_const = int(arena.n_const)
+        self.op = np.asarray(arena.op[:used])
+        self.a = np.asarray(arena.a[:used])
+        self.b = np.asarray(arena.b[:used])
+        self.c = np.asarray(arena.c[:used])
+        self.imm = np.asarray(arena.imm[:used])
+        self.imm2 = np.asarray(arena.imm2[:used])
+        self.n = used
+        self.const_vals = np.asarray(arena.const_vals[:used_const])
         self._memo: Dict[int, object] = {}
         self._var_memo: Dict[int, set] = {}
 

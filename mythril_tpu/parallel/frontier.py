@@ -64,10 +64,16 @@ class LaneContext(A.TxContext):
         self.template = template
 
 
-def _storage_entries(storage) -> Optional[List[Tuple[int, object]]]:
-    """Walk the storage store-chain into (concrete_key, BitVec_value) pairs
-    (latest store wins); None when the chain cannot seed a device table
-    (symbolic key, or a non-zero symbolic base)."""
+def _storage_entries(storage
+                     ) -> Optional[Tuple[List[Tuple[int, object]], bool]]:
+    """Walk the storage store-chain into ((concrete_key, BitVec_value) pairs,
+    base_is_symbolic) — latest store wins. A symbolic BASE (every
+    `--bin-runtime`/`-a` analysis: analysis/symbolic.py seeds
+    `Array("Storage[...]")`, mirroring the reference's lazy Storage at
+    mythril/laser/ethereum/state/account.py:18-76) is device-representable:
+    cold SLOADs fault the slot in as Select(base, key) host-term leaves via
+    the driver's pause service. Only a symbolic KEY anywhere in the chain
+    returns None (device table aliasing would be unsound): host owns those."""
     from ..smt import BitVec
 
     node = storage._standard_storage.raw
@@ -81,8 +87,8 @@ def _storage_entries(storage) -> Optional[List[Tuple[int, object]]]:
     if node.op == "const_array":
         if not (node.args[0].is_const and node.args[0].value == 0):
             return None
-        return list(entries.items())
-    return None  # symbolic base array: host owns this state
+        return list(entries.items()), False
+    return list(entries.items()), True  # symbolic base: fault-in on demand
 
 
 class _Frontier:
@@ -95,6 +101,7 @@ class _Frontier:
         self.materialized = 0
         self.forks = 0
         self.infeasible = 0
+        self.faults = 0  # cold-SLOAD fault-ins serviced
         #: instruction-states executed on device (live lanes x steps) — the
         #: symbolic analogue of the host engine's executed_nodes counter
         self.lane_steps = 0
@@ -102,19 +109,20 @@ class _Frontier:
     # -- seeding -----------------------------------------------------------------------
 
     def seed(self, seed_states: List[GlobalState]) -> Optional[StateBatch]:
-        specs, planes_storage_sym = [], []
+        specs = []
         for template in seed_states:
             account = template.environment.active_account
-            entries = _storage_entries(account.storage)
-            if entries is None:
+            walked = _storage_entries(account.storage)
+            if walked is None:
                 return None  # caller falls back to host for everything
+            entries, base_sym = walked
             code_hex = template.environment.code.bytecode
-            specs.append((template, entries,
+            specs.append((template, entries, base_sym,
                           bytes.fromhex(code_hex[2:] if code_hex.startswith("0x")
                                         else code_hex)))
 
         lane_specs = []
-        for template, entries, code in specs:
+        for template, entries, _base_sym, code in specs:
             # symbolic-valued slots enter the table with a 0 placeholder so
             # the slot EXISTS — storage_sym below overlays the arena node
             # (otherwise device SLOADs would read concrete 0 for them)
@@ -140,7 +148,9 @@ class _Frontier:
 
         storage_sym = np.zeros((self.n_lanes,
                                 state.storage_keys.shape[1]), dtype=np.int32)
-        for lane, (template, entries, _code) in enumerate(specs):
+        storage_base_sym = np.zeros(self.n_lanes, dtype=bool)
+        for lane, (template, entries, base_sym, _code) in enumerate(specs):
+            storage_base_sym[lane] = base_sym
             tx, _ = template.transaction_stack[-1]
             ctx = LaneContext(str(tx.id), template.environment.calldata,
                               template.environment, template)
@@ -162,7 +172,8 @@ class _Frontier:
                 slot = self._storage_slot_of(state, lane, key)
                 if slot is not None:
                     storage_sym[lane, slot] = int(node[0])
-        planes = planes._replace(storage_sym=np.asarray(storage_sym))
+        planes = planes._replace(storage_sym=np.asarray(storage_sym),
+                                 storage_base_sym=np.asarray(storage_base_sym))
         return state, planes
 
     @staticmethod
@@ -254,7 +265,17 @@ class _Frontier:
             planes_np = {field: np.array(getattr(planes, field))
                          for field in planes._fields}
             for lane in forking:
-                self._fork_lane(state_np, planes_np, harena, status, int(lane))
+                # dispatch on the frozen opcode: SLOAD = cold storage
+                # fault-in, JUMPI = path fork
+                pc = int(state_np["pc"][lane])
+                opcode = int(state_np["code"][lane, pc]) \
+                    if pc < int(state_np["code_len"][lane]) else 0
+                if opcode == 0x54:  # SLOAD
+                    self._cold_sload_lane(state_np, planes_np, harena,
+                                          status, int(lane))
+                else:
+                    self._fork_lane(state_np, planes_np, harena, status,
+                                    int(lane))
             state = StateBatch(**{f: state_np[f] for f in state._fields})
             planes = symstep.SymPlanes(**{f: planes_np[f]
                                           for f in planes._fields})
@@ -315,6 +336,59 @@ class _Frontier:
             else:
                 status[side] = DEAD
                 self.infeasible += 1
+
+    def _cold_sload_lane(self, state_np, planes_np, harena, status,
+                         lane: int) -> None:
+        """Fault a storage slot into the device table: the lane paused AT an
+        SLOAD whose concrete key misses the table on a symbolic-base storage.
+        Reads the template's Storage (yielding Select(base, key) — or a known
+        value the chain walk pre-seeded), parks the term as a V_HOST_TERM
+        arena leaf, inserts the slot, and resumes the lane on device."""
+        from . import words
+
+        ctx = self.contexts[self.lane_ctx[lane]]
+        sp = int(state_np["sp"][lane])
+        key = int(words.to_ints(state_np["stack"][lane, sp - 1]))
+        used = state_np["storage_used"][lane]
+        free = np.nonzero(~used)[0]
+        if not len(free):
+            # table capacity exhausted: the host owns this lane from here
+            self._materialize_np(state_np, planes_np, harena, lane)
+            status[lane] = DEAD
+            return
+        slot = int(free[0])
+        account = ctx.template.environment.active_account
+        value = account.storage[symbol_factory.BitVecVal(key, 256)]
+        state_np["storage_keys"][lane, slot] = np.asarray(
+            words.from_int(key))
+        state_np["storage_used"][lane, slot] = True
+        if value.raw.is_const:
+            state_np["storage_vals"][lane, slot] = np.asarray(
+                words.from_int(value.raw.value))
+            planes_np["storage_sym"][lane, slot] = 0
+        else:
+            ctx.host_terms.append(value)
+            self.arena, node, overflow = A.alloc_rows(
+                self.arena,
+                np.asarray([True]), np.asarray([A.VAR], dtype=np.int32),
+                np.asarray([0], dtype=np.int32),
+                np.asarray([0], dtype=np.int32),
+                np.asarray([0], dtype=np.int32),
+                np.asarray([A.V_HOST_TERM], dtype=np.int32),
+                np.asarray([len(ctx.host_terms) - 1], dtype=np.int32))
+            if bool(overflow[0]):
+                # arena exhausted: node id 0 would silently read as
+                # "concrete" — hand the lane to the host instead
+                state_np["storage_used"][lane, slot] = False
+                self._materialize_np(state_np, planes_np, harena, lane)
+                status[lane] = DEAD
+                return
+            planes_np["storage_sym"][lane, slot] = int(node[0])
+        # a fault-in is a READ: dirty stays False, materialization will not
+        # write Select(base, key) back over the template's storage
+        planes_np["storage_dirty"][lane, slot] = False
+        self.faults += 1
+        status[lane] = RUNNING
 
     def _cond_bools(self, planes_np, harena, lane: int) -> List[Bool]:
         ctx = self.contexts[self.lane_ctx[lane]]
@@ -413,11 +487,13 @@ class _Frontier:
                     mstate.memory[offset] = symbol_factory.BitVecVal(
                         int(mem[offset]), 8)
 
-        # storage writes made on device
+        # storage writes made on device (dirty slots only: seeds and
+        # faulted-in reads are already present in the template's storage)
         account = global_state.environment.active_account
         used = state_np["storage_used"][lane]
+        dirty = planes_np["storage_dirty"][lane]
         for slot in range(used.shape[0]):
-            if not used[slot]:
+            if not used[slot] or not dirty[slot]:
                 continue
             key = int(words.to_ints(state_np["storage_keys"][lane, slot]))
             node = int(planes_np["storage_sym"][lane, slot])
@@ -527,11 +603,16 @@ class _Frontier:
             self._materialize(state, planes, harena, int(lane))
 
 
-def execute_message_call_tpu(laser_evm, callee_address) -> None:
+def execute_message_call_tpu(laser_evm, callee_address,
+                             func_hashes=None) -> None:
     """Drop-in for core/transaction/symbolic.py execute_message_call: seed the
     device frontier from every open state, explore, and drain the escaped
-    states through the host engine (detectors run there unchanged)."""
-    from ..core.transaction.symbolic import ACTORS
+    states through the host engine (detectors run there unchanged).
+    `func_hashes` restricts the tx's 4-byte selector exactly as on the host
+    path (generate_function_constraints) so `--transaction-sequences` and the
+    tx prioritizer behave identically under both engines."""
+    from ..core.transaction.symbolic import (ACTORS,
+                                             generate_function_constraints)
     from ..core.state.calldata import SymbolicCalldata
     from ..core.transaction.transaction_models import (
         MessageCallTransaction, get_next_transaction_id)
@@ -565,6 +646,10 @@ def execute_message_call_tpu(laser_evm, callee_address) -> None:
         template.world_state.constraints.append(
             Or(*[transaction.caller == actor
                  for actor in ACTORS.addresses.values()]))
+        if func_hashes:
+            for constraint in generate_function_constraints(calldata,
+                                                            func_hashes):
+                template.world_state.constraints.append(constraint)
         if getattr(laser_evm, "requires_statespace", False):
             laser_evm.new_node_for_transaction(template, transaction)
         seeds.append(template)
@@ -580,7 +665,9 @@ def execute_message_call_tpu(laser_evm, callee_address) -> None:
                          n_lanes=max(lane_budget, 2 * len(seeds)))
     seeded = frontier.seed(seeds)
     if seeded is None:
-        log.info("frontier: storage not device-representable; host fallback")
+        log.warning("--engine tpu: storage store-chain has a symbolic key; "
+                    "the device cannot soundly alias it — this transaction "
+                    "runs entirely on the host engine")
         for template in seeds:
             laser_evm.work_list.append(template)
         laser_evm.exec()
@@ -588,9 +675,10 @@ def execute_message_call_tpu(laser_evm, callee_address) -> None:
 
     state, planes = seeded
     frontier.run(state, planes)
-    log.info("frontier: %d forks, %d infeasible pruned, %d states "
-             "materialized for the host (arena nodes: %d)", frontier.forks,
-             frontier.infeasible, frontier.materialized, int(frontier.arena.n))
+    log.info("frontier: %d forks, %d storage fault-ins, %d infeasible "
+             "pruned, %d states materialized for the host (arena nodes: %d)",
+             frontier.forks, frontier.faults, frontier.infeasible,
+             frontier.materialized, int(frontier.arena.n))
     # cumulative counters for benchmarking/diagnostics (bench.py)
     laser_evm.frontier_lane_steps = getattr(
         laser_evm, "frontier_lane_steps", 0) + frontier.lane_steps

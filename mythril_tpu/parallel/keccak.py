@@ -68,48 +68,66 @@ def _rotl64(lo, hi, n):
     return new_lo, new_hi
 
 
+_RC_LO_T = jnp.asarray(_RC_LO)
+_RC_HI_T = jnp.asarray(_RC_HI)
+
+
+def _keccak_round(lo, hi, rc_lo, rc_hi):
+    """One keccak-f round (rc_* may be traced scalars). Rotation amounts stay
+    static, so the round body is a fixed xor/or/shift DAG; keccak_f rolls the
+    24 rounds into a fori_loop so the DAG is compiled ONCE, not 24x per
+    absorbed block — the unrolled version dominated the whole interpreter's
+    XLA program (~87% of sym_step's HLO) and pushed TPU compile past 2 min."""
+    # theta
+    c_lo = [lo[..., x] ^ lo[..., x + 5] ^ lo[..., x + 10]
+            ^ lo[..., x + 15] ^ lo[..., x + 20] for x in range(5)]
+    c_hi = [hi[..., x] ^ hi[..., x + 5] ^ hi[..., x + 10]
+            ^ hi[..., x + 15] ^ hi[..., x + 20] for x in range(5)]
+    d_lo, d_hi = [], []
+    for x in range(5):
+        rot_lo, rot_hi = _rotl64(c_lo[(x + 1) % 5], c_hi[(x + 1) % 5], 1)
+        d_lo.append(c_lo[(x + 4) % 5] ^ rot_lo)
+        d_hi.append(c_hi[(x + 4) % 5] ^ rot_hi)
+    lo = jnp.stack([lo[..., i] ^ d_lo[i % 5] for i in range(25)], axis=-1)
+    hi = jnp.stack([hi[..., i] ^ d_hi[i % 5] for i in range(25)], axis=-1)
+
+    # rho + pi
+    b_lo = [None] * 25
+    b_hi = [None] * 25
+    for x in range(5):
+        for y in range(5):
+            src = x + 5 * y
+            dst = y + 5 * ((2 * x + 3 * y) % 5)
+            b_lo[dst], b_hi[dst] = _rotl64(
+                lo[..., src], hi[..., src], int(_ROTATIONS[src]))
+
+    # chi
+    new_lo, new_hi = [], []
+    for y in range(5):
+        for x in range(5):
+            i = x + 5 * y
+            i1 = (x + 1) % 5 + 5 * y
+            i2 = (x + 2) % 5 + 5 * y
+            new_lo.append(b_lo[i] ^ ((~b_lo[i1]) & b_lo[i2]))
+            new_hi.append(b_hi[i] ^ ((~b_hi[i1]) & b_hi[i2]))
+    lo = jnp.stack(new_lo, axis=-1)
+    hi = jnp.stack(new_hi, axis=-1)
+
+    # iota
+    lo = lo.at[..., 0].set(lo[..., 0] ^ rc_lo)
+    hi = hi.at[..., 0].set(hi[..., 0] ^ rc_hi)
+    return lo, hi
+
+
 def keccak_f(lo: jnp.ndarray, hi: jnp.ndarray):
     """keccak-f[1600] permutation. lo/hi: uint32[..., 25]."""
-    for round_index in range(24):
-        # theta
-        c_lo = [lo[..., x] ^ lo[..., x + 5] ^ lo[..., x + 10]
-                ^ lo[..., x + 15] ^ lo[..., x + 20] for x in range(5)]
-        c_hi = [hi[..., x] ^ hi[..., x + 5] ^ hi[..., x + 10]
-                ^ hi[..., x + 15] ^ hi[..., x + 20] for x in range(5)]
-        d_lo, d_hi = [], []
-        for x in range(5):
-            rot_lo, rot_hi = _rotl64(c_lo[(x + 1) % 5], c_hi[(x + 1) % 5], 1)
-            d_lo.append(c_lo[(x + 4) % 5] ^ rot_lo)
-            d_hi.append(c_hi[(x + 4) % 5] ^ rot_hi)
-        lo = jnp.stack([lo[..., i] ^ d_lo[i % 5] for i in range(25)], axis=-1)
-        hi = jnp.stack([hi[..., i] ^ d_hi[i % 5] for i in range(25)], axis=-1)
 
-        # rho + pi
-        b_lo = [None] * 25
-        b_hi = [None] * 25
-        for x in range(5):
-            for y in range(5):
-                src = x + 5 * y
-                dst = y + 5 * ((2 * x + 3 * y) % 5)
-                b_lo[dst], b_hi[dst] = _rotl64(
-                    lo[..., src], hi[..., src], int(_ROTATIONS[src]))
+    def body(round_index, carry):
+        lo, hi = carry
+        return _keccak_round(lo, hi, _RC_LO_T[round_index],
+                             _RC_HI_T[round_index])
 
-        # chi
-        new_lo, new_hi = [], []
-        for y in range(5):
-            for x in range(5):
-                i = x + 5 * y
-                i1 = (x + 1) % 5 + 5 * y
-                i2 = (x + 2) % 5 + 5 * y
-                new_lo.append(b_lo[i] ^ ((~b_lo[i1]) & b_lo[i2]))
-                new_hi.append(b_hi[i] ^ ((~b_hi[i1]) & b_hi[i2]))
-        lo = jnp.stack(new_lo, axis=-1)
-        hi = jnp.stack(new_hi, axis=-1)
-
-        # iota
-        lo = lo.at[..., 0].set(lo[..., 0] ^ U32(_RC_LO[round_index]))
-        hi = hi.at[..., 0].set(hi[..., 0] ^ U32(_RC_HI[round_index]))
-    return lo, hi
+    return jax.lax.fori_loop(0, 24, body, (lo, hi))
 
 
 def keccak256(data: jnp.ndarray, length: jnp.ndarray) -> jnp.ndarray:
@@ -144,13 +162,21 @@ def keccak256(data: jnp.ndarray, length: jnp.ndarray) -> jnp.ndarray:
     hi = jnp.zeros(batch_shape + (25,), dtype=U32)
     lane_blocks = padded_len // RATE
     pad_lanes = jnp.zeros(batch_shape + (25 - LANES,), dtype=U32)
-    for b in range(n_blocks):
-        absorb_lo = jnp.concatenate([block_lo[..., b, :], pad_lanes], axis=-1)
-        absorb_hi = jnp.concatenate([block_hi[..., b, :], pad_lanes], axis=-1)
+
+    def absorb(b, carry):
+        lo, hi = carry
+        absorb_lo = jnp.concatenate(
+            [jax.lax.dynamic_index_in_dim(block_lo, b, axis=len(batch_shape),
+                                          keepdims=False), pad_lanes], axis=-1)
+        absorb_hi = jnp.concatenate(
+            [jax.lax.dynamic_index_in_dim(block_hi, b, axis=len(batch_shape),
+                                          keepdims=False), pad_lanes], axis=-1)
         new_lo, new_hi = keccak_f(lo ^ absorb_lo, hi ^ absorb_hi)
         active = (b < lane_blocks)[..., None]
-        lo = jnp.where(active, new_lo, lo)
-        hi = jnp.where(active, new_hi, hi)
+        return (jnp.where(active, new_lo, lo),
+                jnp.where(active, new_hi, hi))
+
+    lo, hi = jax.lax.fori_loop(0, n_blocks, absorb, (lo, hi))
 
     # squeeze 32 bytes from lanes 0..3
     out_lanes_lo = lo[..., 0:4]

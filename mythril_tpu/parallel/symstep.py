@@ -87,6 +87,8 @@ class SymPlanes(NamedTuple):
     stack_sym: jnp.ndarray     # int32[B, S] arena node per stack slot
     mem_sym: jnp.ndarray       # int32[B, M] (node << 5 | byte_index), 0=concrete
     storage_sym: jnp.ndarray   # int32[B, K] arena node per storage slot value
+    storage_dirty: jnp.ndarray  # bool[B, K] slot written (not just faulted in)
+    storage_base_sym: jnp.ndarray  # bool[B] storage base array is symbolic
     conds: jnp.ndarray         # int32[B, KC] signed node ids (neg = negated)
     cond_count: jnp.ndarray    # int32[B]
     fork_cond: jnp.ndarray     # int32[B] node id pending at a FORKING lane
@@ -99,6 +101,8 @@ class SymPlanes(NamedTuple):
             stack_sym=jnp.zeros((batch, stack_slots), dtype=I32),
             mem_sym=jnp.zeros((batch, mem_bytes), dtype=I32),
             storage_sym=jnp.zeros((batch, storage_slots), dtype=I32),
+            storage_dirty=jnp.zeros((batch, storage_slots), dtype=bool),
+            storage_base_sym=jnp.zeros(batch, dtype=bool),
             conds=jnp.zeros((batch, max_conds), dtype=I32),
             cond_count=jnp.zeros(batch, dtype=I32),
             fork_cond=jnp.zeros(batch, dtype=I32),
@@ -167,7 +171,9 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena
     mload_first = jnp.take_along_axis(
         planes.mem_sym, jnp.clip(off_i, 0, mem_cap - 1).astype(I32)[:, None],
         axis=1)[:, 0]
-    j32 = jnp.arange(32)
+    # int32 so scattered plane values never promote to int64 (x64 is on;
+    # an int64 value into the int32 mem_sym plane is a future hard error)
+    j32 = jnp.arange(32, dtype=I32)
     mload_idx = jnp.clip(off_i[:, None] + j32, 0, mem_cap - 1).astype(I32)
     mload_cells = jnp.take_along_axis(planes.mem_sym, mload_idx, axis=1)
     mload_any_sym = jnp.any(mload_cells != 0, axis=1)
@@ -190,9 +196,17 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena
         sload_mask & storage_found,
         planes.storage_sym[lane, storage_slot], 0)
 
-    # ---- classify: FORK -------------------------------------------------------------
+    # ---- classify: FORK / PAUSE -----------------------------------------------------
     jumpi_sym_cond = running & is_op("JUMPI") & (sym2 != 0) & (sym1 == 0)
-    force_fork = jumpi_sym_cond
+    # cold SLOAD on a symbolic-base storage: the key is concrete but absent
+    # from the device table — pause the lane (status FORKING, pc still at the
+    # SLOAD) so the driver can fault the slot in as a Select(base, key)
+    # host-term leaf and resume the lane on device (the reference's lazy
+    # Storage fault-in, mythril/laser/ethereum/state/account.py:43-76,
+    # re-expressed as a host service)
+    sload_cold = sload_mask & (sym1 == 0) & planes.storage_base_sym \
+        & ~storage_found
+    force_fork = jumpi_sym_cond | sload_cold
 
     # ---- classify: ESCAPE -----------------------------------------------------------
     sym_representable = SYM_OK_T[op] | PLUMBING_T[op]
@@ -325,13 +339,21 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena
         jnp.where(sstore_any, lane, batch),
         jnp.where(sstore_any, new_slot, 0)].set(
         jnp.where(sstore_any, sym2, 0), mode="drop")
+    # every SSTORE marks its slot dirty: materialization writes back only
+    # dirty slots (faulted-in reads and seeds are already in the template)
+    storage_dirty = new_planes.storage_dirty.at[
+        jnp.where(sstore_any, lane, batch),
+        jnp.where(sstore_any, new_slot, 0)].set(
+        jnp.where(sstore_any, True, False), mode="drop")
 
-    # fork condition for paused lanes
-    fork_cond = jnp.where((state.status == RUNNING) & force_fork, sym2,
+    # fork condition for JUMPI-paused lanes (cold-SLOAD pauses carry none:
+    # the driver dispatches on the opcode under the frozen pc)
+    fork_cond = jnp.where((state.status == RUNNING) & jumpi_sym_cond, sym2,
                           new_planes.fork_cond)
 
     new_planes = new_planes._replace(mem_sym=mem_sym,
                                      storage_sym=storage_sym,
+                                     storage_dirty=storage_dirty,
                                      fork_cond=fork_cond)
     return new_state, new_planes, arena
 

@@ -28,12 +28,19 @@ U32 = jnp.uint32
 
 # -- host converters -----------------------------------------------------------------
 
-def from_int(value: int, batch_shape=()) -> jnp.ndarray:
-    """Python int -> word tensor (broadcast to batch_shape + (NLIMBS,))."""
+def from_int(value: int, batch_shape=()) -> np.ndarray:
+    """Python int -> word tensor (broadcast to batch_shape + (NLIMBS,)).
+
+    Returns NUMPY, deliberately: this is a host-side packing helper called in
+    per-lane Python loops (build_batch seeding, storage fault-in). Returning a
+    device array here cost two tunnel round-trips per call on the remote-TPU
+    backend — at 512 lanes that serialized seeding into minutes of dead time
+    (the BENCH_r03 stall). Device code broadcasting a constant word should go
+    through jnp on its own."""
     value &= (1 << WORD_BITS) - 1
     limbs = np.array([(value >> (LIMB_BITS * i)) & LIMB_MASK
                       for i in range(NLIMBS)], dtype=np.uint32)
-    return jnp.broadcast_to(jnp.asarray(limbs), tuple(batch_shape) + (NLIMBS,))
+    return np.broadcast_to(limbs, tuple(batch_shape) + (NLIMBS,))
 
 def to_ints(words) -> np.ndarray:
     """Word tensor -> object ndarray of Python ints (host-side, for tests/escapes)."""

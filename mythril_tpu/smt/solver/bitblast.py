@@ -33,6 +33,13 @@ class Blaster:
         self.var_bits: Dict[terms.Term, List[int]] = {}
         #: input Bool var term -> literal
         self.var_lits: Dict[terms.Term, int] = {}
+        #: gate var -> (first clause index, clause count) of its definition
+        self.gate_clauses: Dict[int, Tuple[int, int]] = {}
+        #: gate var -> abs child vars — the cone-of-influence edge list used
+        #: by the incremental pipeline to ship only a query's reachable
+        #: definitions to the device SAT lane (the pool itself outgrows the
+        #: device clause cap within a few queries)
+        self.gate_children: Dict[int, Tuple[int, ...]] = {}
 
     # -- gate layer ------------------------------------------------------------------
     def new_lit(self) -> int:
@@ -55,6 +62,8 @@ class Blaster:
         if hit is not None:
             return hit
         c = self.new_lit()
+        self.gate_clauses[c] = (len(self.clauses), 3)
+        self.gate_children[c] = (abs(a), abs(b))
         self.clauses += [[-a, -b, c], [a, -c], [b, -c]]
         self._gate_cache[key] = c
         return c
@@ -81,6 +90,8 @@ class Blaster:
         if hit is not None:
             return hit
         c = self.new_lit()
+        self.gate_clauses[c] = (len(self.clauses), 4)
+        self.gate_children[c] = (abs(a), abs(b))
         self.clauses += [[-a, -b, -c], [a, b, -c], [a, -b, c], [-a, b, c]]
         self._gate_cache[key] = c
         return c
@@ -102,6 +113,8 @@ class Blaster:
         if hit is not None:
             return hit
         c = self.new_lit()
+        self.gate_clauses[c] = (len(self.clauses), 4)
+        self.gate_children[c] = (abs(s), abs(a), abs(b))
         self.clauses += [[-s, -a, c], [-s, a, -c], [s, -b, c], [s, b, -c]]
         self._gate_cache[key] = c
         return c
@@ -360,6 +373,7 @@ class Blaster:
             return self.sle(self._bv_cache[args[0]], self._bv_cache[args[1]])
         raise ValueError(f"cannot bit-blast Bool op {op}")
 
-    def assert_true(self, node: terms.Term) -> None:
+    def assert_true(self, node: terms.Term) -> int:
         lit = self.blast_bool(node)
         self.clauses.append([lit])
+        return lit

@@ -136,6 +136,9 @@ class IncrementalPipeline:
         self._fresh_sets: Dict[terms.Term, FrozenSet[terms.Term]] = {}
         self._ack_emitted: set = set()
         self._shipped = 0  # clause-pool cursor already sent to the session
+        #: (fresh-var pair, root lit) of every asserted Ackermann fact — the
+        #: device cone extractor re-asserts the facts relevant to a query
+        self._fact_lits: List[Tuple[Tuple[terms.Term, terms.Term], int]] = []
 
     # -- fresh-var bookkeeping -------------------------------------------------------
 
@@ -191,10 +194,14 @@ class IncrementalPipeline:
                     frontier |= self._fresh_set(arg)
         return frozenset(seen)
 
-    def _emit_ackermann(self, fresh_vars: FrozenSet[terms.Term]) -> List[terms.Term]:
+    def _emit_ackermann(self, fresh_vars: FrozenSet[terms.Term]
+                        ) -> List[Tuple[Tuple[terms.Term, terms.Term],
+                                        terms.Term]]:
         """Assert (once, unconditionally — they are valid facts) the pairwise
-        consistency implications among the query's reads/UF applications."""
-        facts: List[terms.Term] = []
+        consistency implications among the query's reads/UF applications.
+        Returns (fresh-var pair, fact) so the caller can register the fact's
+        root literal for device cone extraction."""
+        facts: List[Tuple[Tuple[terms.Term, terms.Term], terms.Term]] = []
         by_base: Dict[int, List[terms.Term]] = {}
         by_name: Dict[str, List[terms.Term]] = {}
         for fresh in sorted(fresh_vars, key=lambda t: t.params[0]):
@@ -212,7 +219,7 @@ class IncrementalPipeline:
                 fact = read_pair_fact(self.fresh_read[fresh_a][1], fresh_a,
                                       self.fresh_read[fresh_b][1], fresh_b)
                 if fact is not None:
-                    facts.append(fact)
+                    facts.append(((fresh_a, fresh_b), fact))
         for group in by_name.values():
             for fresh_a, fresh_b in itertools.combinations(group, 2):
                 key = (fresh_a, fresh_b)
@@ -222,16 +229,18 @@ class IncrementalPipeline:
                 fact = uf_pair_fact(self.fresh_uf[fresh_a][1], fresh_a,
                                     self.fresh_uf[fresh_b][1], fresh_b)
                 if fact is not None:
-                    facts.append(fact)
+                    facts.append(((fresh_a, fresh_b), fact))
         return facts
 
     # -- the decision procedure ------------------------------------------------------
 
     def check(self, raw_constraints: List[terms.Term], max_conflicts: int,
-              device_solve=None) -> Tuple[str, Optional[Model]]:
+              device_solve=None, timeout_ms: int = 0
+              ) -> Tuple[str, Optional[Model]]:
         """Same contract as solver.check_formulas. `device_solve` is an
         optional callable(clauses, n_vars, max_conflicts) -> (status, bits)
-        used as a pre-pass (the --solver jax lane)."""
+        used as a pre-pass (the --solver jax lane). timeout_ms > 0 is a hard
+        wall-clock deadline enforced inside the native solve loop."""
         reads_before = len(self.info.array_reads)
         ufs_before = len(self.info.uf_applications)
         lowered = [_lower(c, self.lower_cache, self.info)
@@ -239,8 +248,10 @@ class IncrementalPipeline:
         self._sync_registries(reads_before, ufs_before)
 
         fresh_vars = self._query_fresh_closure(lowered)
-        for fact in self._emit_ackermann(fresh_vars):
-            self.blaster.assert_true(fact)  # unconditional unit in the pool
+        for pair, fact in self._emit_ackermann(fresh_vars):
+            # unconditional unit in the pool; the root lit is registered so
+            # the device cone extractor can re-assert the relevant facts
+            self._fact_lits.append((pair, self.blaster.assert_true(fact)))
 
         assumptions = [self.blaster.blast_bool(node) for node in lowered]
 
@@ -253,23 +264,85 @@ class IncrementalPipeline:
 
         status, bits = sat.UNKNOWN, None
         if device_solve is not None:
-            from ...parallel.jax_solver import DEFAULT_CLAUSE_CAP
-
-            # once the pool outgrows the device cap the DPLL can never answer;
-            # skip the O(pool) copy + dispatch instead of paying it per query
-            if len(self.blaster.clauses) + len(assumptions) <= DEFAULT_CLAUSE_CAP:
-                status, bits = device_solve(
-                    self.blaster.clauses + [[lit] for lit in assumptions],
-                    self.blaster.n_vars, max_conflicts)
+            # the monotone pool outgrows any device cap within a few queries;
+            # ship only the query's cone of influence — definitions reachable
+            # from the assumption roots plus the Ackermann facts over the
+            # query's own reads/UFs (SURVEY §2.3: keep device problems small
+            # instead of sharding an almost-entirely-irrelevant matrix)
+            sub = self._device_subproblem(assumptions, fresh_vars)
+            if sub is not None:
+                sub_clauses, n_sub_vars, renumber = sub
+                status, sub_bits = device_solve(sub_clauses, n_sub_vars,
+                                                max_conflicts)
+                if status == sat.SAT and sub_bits is not None:
+                    bits = [False] * self.blaster.n_vars
+                    for global_var, sub_var in renumber.items():
+                        if sub_var - 1 < len(sub_bits):
+                            bits[global_var - 1] = sub_bits[sub_var - 1]
         if status == sat.UNKNOWN:
             status, bits = self.session.solve(
-                assumptions, self.blaster.n_vars, max_conflicts)
+                assumptions, self.blaster.n_vars, max_conflicts, timeout_ms)
 
         if status == sat.UNSAT:
             return "unsat", None
         if status == sat.UNKNOWN:
             return "unknown", None
         return "sat", self._build_model(bits, fresh_vars, lowered)
+
+    def _device_subproblem(self, assumptions: List[int],
+                           fresh_vars: FrozenSet[terms.Term]):
+        """Extract the query's cone of influence from the monotone pool as a
+        self-contained renumbered CNF for the device DPLL.
+
+        Included: the pinned-TRUE unit, every gate definition reachable
+        downward from the assumption roots and from the relevant Ackermann
+        fact roots (facts whose fresh-var pair lies inside the query's
+        closure), the fact units themselves, and one unit per assumption.
+        Soundness: definitions are full biconditionals, so a model of the
+        cone extends to the excluded gates functionally, and excluded fact
+        units only constrain reads outside the query's closure (the same
+        per-query pairing the one-shot pipeline uses). Returns
+        (clauses, n_vars, {global_var: sub_var}) or None when the cone
+        exceeds the device cap."""
+        from ...parallel.jax_solver import DEFAULT_CLAUSE_CAP
+
+        blaster = self.blaster
+        fact_lits = [lit for pair, lit in self._fact_lits
+                     if pair[0] in fresh_vars and pair[1] in fresh_vars]
+        clause_indices: List[int] = [0]  # pinned TRUE
+        stack = [abs(lit) for lit in assumptions] \
+            + [abs(lit) for lit in fact_lits]
+        visited = set()
+        budget = DEFAULT_CLAUSE_CAP - len(fact_lits) - len(assumptions) - 1
+        while stack:
+            var = stack.pop()
+            if var in visited or var == 1:
+                continue
+            visited.add(var)
+            definition = blaster.gate_clauses.get(var)
+            if definition is None:
+                continue  # input bit: leaf
+            start, count = definition
+            clause_indices.extend(range(start, start + count))
+            if len(clause_indices) > budget:
+                return None
+            stack.extend(blaster.gate_children[var])
+
+        renumber: Dict[int, int] = {1: 1}
+
+        def sub_lit(lit: int) -> int:
+            var = abs(lit)
+            sub_var = renumber.get(var)
+            if sub_var is None:
+                sub_var = len(renumber) + 1
+                renumber[var] = sub_var
+            return sub_var if lit > 0 else -sub_var
+
+        sub_clauses = [[sub_lit(lit) for lit in blaster.clauses[index]]
+                       for index in clause_indices]
+        sub_clauses += [[sub_lit(lit)] for lit in fact_lits]
+        sub_clauses += [[sub_lit(lit)] for lit in assumptions]
+        return sub_clauses, len(renumber), renumber
 
     def _build_model(self, bits: List[bool], fresh_vars: FrozenSet[terms.Term],
                      lowered: List[terms.Term]) -> Model:

@@ -58,7 +58,8 @@ def _load_lib():
         try:
             lib = ctypes.CDLL(path)
             lib.mtpu_solve.argtypes = [ctypes.POINTER(ctypes.c_int32), ctypes.c_size_t,
-                                       ctypes.c_int32, ctypes.c_int64, ctypes.c_char_p]
+                                       ctypes.c_int32, ctypes.c_int64, ctypes.c_char_p,
+                                       ctypes.c_int64]
             lib.mtpu_solve.restype = ctypes.c_int
             lib.mtpu_session_new.argtypes = []
             lib.mtpu_session_new.restype = ctypes.c_void_p
@@ -71,7 +72,8 @@ def _load_lib():
             lib.mtpu_session_solve.argtypes = [ctypes.c_void_p,
                                                ctypes.POINTER(ctypes.c_int32),
                                                ctypes.c_size_t, ctypes.c_int64,
-                                               ctypes.c_char_p, ctypes.c_int32]
+                                               ctypes.c_char_p, ctypes.c_int32,
+                                               ctypes.c_int64]
             lib.mtpu_session_solve.restype = ctypes.c_int
             _lib = lib
         except (OSError, AttributeError) as error:
@@ -123,15 +125,17 @@ class Session:
         return not self.broken
 
     def solve(self, assumptions: List[int], n_vars: int,
-              max_conflicts: int = 2_000_000
+              max_conflicts: int = 2_000_000, timeout_ms: int = 0
               ) -> Tuple[int, Optional[List[bool]]]:
+        """timeout_ms > 0 enforces a wall-clock deadline inside the native
+        solve loop (the conflict budget is only a throughput proxy)."""
         if self.broken:
             return UNSAT, None
         assume = (ctypes.c_int32 * max(1, len(assumptions)))(*assumptions)
         model_buf = ctypes.create_string_buffer(max(1, n_vars))
         status = self._lib.mtpu_session_solve(
             self._handle, assume, len(assumptions), max_conflicts,
-            model_buf, n_vars)
+            model_buf, n_vars, timeout_ms)
         if status == SAT:
             return SAT, [model_buf.raw[v] == 1 for v in range(n_vars)]
         return status, None
@@ -149,13 +153,15 @@ class Session:
 
 
 def solve_cnf(clauses: List[List[int]], n_vars: int,
-              max_conflicts: int = 2_000_000) -> Tuple[int, Optional[List[bool]]]:
+              max_conflicts: int = 2_000_000, timeout_ms: int = 0
+              ) -> Tuple[int, Optional[List[bool]]]:
     """Returns (status, model). model[v-1] is the boolean for DIMACS var v on SAT."""
     lib = _load_lib()
     if lib is not None:
         flat, total = _flatten(clauses)
         model_buf = ctypes.create_string_buffer(max(1, n_vars))
-        status = lib.mtpu_solve(flat, total, n_vars, max_conflicts, model_buf)
+        status = lib.mtpu_solve(flat, total, n_vars, max_conflicts, model_buf,
+                                timeout_ms)
         if status == SAT:
             return SAT, [model_buf.raw[v] == 1 for v in range(n_vars)]
         return status, None

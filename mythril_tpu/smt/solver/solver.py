@@ -53,10 +53,12 @@ def _device_solve(clauses, n_vars, max_conflicts):
         status, model = jax_solver.UNKNOWN, None
     if status == jax_solver.UNKNOWN:
         statistics.device_fallbacks += 1
+    else:
+        statistics.device_solved += 1
     return status, model
 
 
-def _solve_backend(clauses, n_vars, max_conflicts):
+def _solve_backend(clauses, n_vars, max_conflicts, timeout_ms=0):
     """Route to the configured SAT backend (one-shot, non-incremental path)."""
     from ...support.support_args import args
 
@@ -64,7 +66,7 @@ def _solve_backend(clauses, n_vars, max_conflicts):
         status, model = _device_solve(clauses, n_vars, max_conflicts)
         if status != sat.UNKNOWN:
             return status, model
-    return sat.solve_cnf(clauses, n_vars, max_conflicts)
+    return sat.solve_cnf(clauses, n_vars, max_conflicts, timeout_ms)
 
 
 #: process-wide incremental pipeline (persistent blast pool + CDCL session);
@@ -85,8 +87,11 @@ def _get_pipeline():
 
 
 def check_formulas(raw_constraints: List[terms.Term],
-                   max_conflicts: int = 2_000_000) -> Tuple[str, Optional[Model]]:
-    """The core decision procedure. Returns ("sat"|"unsat"|"unknown", model)."""
+                   max_conflicts: int = 2_000_000,
+                   timeout_ms: int = 0) -> Tuple[str, Optional[Model]]:
+    """The core decision procedure. Returns ("sat"|"unsat"|"unknown", model).
+    timeout_ms > 0 enforces a wall-clock deadline inside the native solver
+    (reference analogue: the get_model watchdog, support/model.py:104-119)."""
     # fast path: constant constraints
     pending = []
     for constraint in raw_constraints:
@@ -103,7 +108,8 @@ def check_formulas(raw_constraints: List[terms.Term],
         from ...support.support_args import args
 
         device = _device_solve if args.solver == "jax" else None
-        return pipeline.check(pending, max_conflicts, device_solve=device)
+        return pipeline.check(pending, max_conflicts, device_solve=device,
+                              timeout_ms=timeout_ms)
 
     # one-shot fallback (no native CDCL build): re-lower + re-blast per query
     lowered, info = lower_constraints(pending)
@@ -111,7 +117,7 @@ def check_formulas(raw_constraints: List[terms.Term],
     for constraint in lowered:
         blaster.assert_true(constraint)
     status, sat_model = _solve_backend(blaster.clauses, blaster.n_vars,
-                                       max_conflicts)
+                                       max_conflicts, timeout_ms)
     if status == sat.UNSAT:
         return "unsat", None
     if status == sat.UNKNOWN:
@@ -165,7 +171,8 @@ class BaseSolver:
     @stat_smt_query
     def check(self, *extra) -> str:
         raw = [c.raw for c in list(self.constraints) + list(extra)]
-        status, model = check_formulas(raw, self._budget())
+        status, model = check_formulas(raw, self._budget(),
+                                       timeout_ms=self.timeout or 0)
         self._model = model
         return status
 

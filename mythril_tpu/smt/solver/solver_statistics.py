@@ -20,6 +20,7 @@ class SolverStatistics:
             cls._instance.solver_time = 0.0
             cls._instance.device_queries = 0
             cls._instance.device_fallbacks = 0
+            cls._instance.device_solved = 0
         return cls._instance
 
     def reset(self) -> None:
@@ -27,13 +28,15 @@ class SolverStatistics:
         self.solver_time = 0.0
         self.device_queries = 0
         self.device_fallbacks = 0
+        self.device_solved = 0
 
     def __repr__(self):
         out = (f"Solver statistics: query count: {self.query_count}, "
                f"solver time: {self.solver_time:.3f}s")
         if self.device_queries:
             out += (f", device queries: {self.device_queries}"
-                    f" (fallbacks to CDCL: {self.device_fallbacks})")
+                    f" (device solved: {self.device_solved}, "
+                    f"fallbacks to CDCL: {self.device_fallbacks})")
         return out
 
 

@@ -9,6 +9,7 @@
 // C ABI (ctypes): clauses arrive as a flat 0-terminated literal stream in DIMACS
 // convention (+v / -v, variables 1-indexed). Returns 1 SAT / 0 UNSAT / -1 budget
 // exceeded; on SAT, model_out[v-1] holds 0/1 per variable.
+#include <chrono>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -76,18 +77,32 @@ class Solver {
     return true;
   }
 
-  // 1 SAT, 0 UNSAT (under assumptions), -1 budget exceeded
-  int solve(int64_t max_conflicts, const std::vector<Lit>& assumptions = {}) {
+  // 1 SAT, 0 UNSAT (under assumptions), -1 budget exceeded.
+  // timeout_ms > 0 adds a wall-clock deadline beside the conflict budget:
+  // the conflict count is only a throughput *proxy* (solver.py
+  // CONFLICTS_PER_MS) and individual queries were measured blowing ~20%
+  // past --solver-timeout on conflict count alone; the reference enforces
+  // a hard watchdog (mythril/support/model.py:104-119).
+  int solve(int64_t max_conflicts, const std::vector<Lit>& assumptions = {},
+            int64_t timeout_ms = 0) {
     if (broken_) return 0;
+    using Clock = std::chrono::steady_clock;
+    const bool timed = timeout_ms > 0;
+    const Clock::time_point deadline =
+        timed ? Clock::now() + std::chrono::milliseconds(timeout_ms)
+              : Clock::time_point();
     cancel_until(0);
     if (propagate() != -1) { broken_ = true; return 0; }  // top-level conflict
     int64_t conflicts = 0;
+    int64_t decisions = 0;
     int64_t restart_limit = luby(restart_count_) * 128;
     int64_t reduce_limit = 4000 + static_cast<int64_t>(num_learned_);
     for (;;) {
       int confl = propagate();
       if (confl != -1) {
         ++conflicts;
+        if (timed && (conflicts & 255) == 0 && Clock::now() >= deadline)
+          return -1;
         if (decision_level() == 0) { broken_ = true; return 0; }
         if (decision_level() <= static_cast<int>(assumptions.size()))
           return 0;  // conflict forced by the assumption prefix alone
@@ -121,6 +136,8 @@ class Solver {
         new_decision_level();
         if (value(a) == kUndef) enqueue(a, -1);
       } else {
+        if (timed && (++decisions & 8191) == 0 && Clock::now() >= deadline)
+          return -1;
         int next = pick_branch_var();
         if (next == -1) return 1;  // all assigned: SAT
         new_decision_level();
@@ -415,10 +432,11 @@ static bool feed_clauses(Solver& solver, const int32_t* lits, size_t n_lits) {
 }
 
 extern "C" int mtpu_solve(const int32_t* lits, size_t n_lits, int32_t n_vars,
-                          int64_t max_conflicts, uint8_t* model_out) {
+                          int64_t max_conflicts, uint8_t* model_out,
+                          int64_t timeout_ms) {
   Solver solver(n_vars);
   if (!feed_clauses(solver, lits, n_lits)) return 0;
-  int result = solver.solve(max_conflicts);
+  int result = solver.solve(max_conflicts, {}, timeout_ms);
   if (result == 1 && model_out) {
     for (int v = 0; v < n_vars; ++v)
       model_out[v] = solver.model(v) == kTrue ? 1 : 0;
@@ -453,7 +471,8 @@ extern "C" int mtpu_session_add(void* handle, const int32_t* lits,
 // On SAT, model_out[v-1] holds 0/1 for vars 1..n_vars.
 extern "C" int mtpu_session_solve(void* handle, const int32_t* assumptions,
                                   size_t n_assumptions, int64_t max_conflicts,
-                                  uint8_t* model_out, int32_t n_vars) {
+                                  uint8_t* model_out, int32_t n_vars,
+                                  int64_t timeout_ms) {
   Solver* solver = static_cast<Solver*>(handle);
   solver->ensure_vars(n_vars);
   std::vector<Lit> assume;
@@ -462,7 +481,7 @@ extern "C" int mtpu_session_solve(void* handle, const int32_t* assumptions,
     int32_t l = assumptions[i];
     assume.push_back(mk_lit(std::abs(l) - 1, l < 0));
   }
-  int result = solver->solve(max_conflicts, assume);
+  int result = solver->solve(max_conflicts, assume, timeout_ms);
   if (result == 1 && model_out) {
     for (int v = 0; v < n_vars; ++v)
       model_out[v] = solver->model(v) == kTrue ? 1 : 0;
