@@ -42,7 +42,9 @@ class SymExecWrapper:
                  compulsory_statespace: bool = True,
                  disable_dependency_pruning: bool = False,
                  run_analysis_modules: bool = True, enable_coverage_strategy: bool = False,
-                 custom_modules_directory: str = "", engine: str = "host"):
+                 custom_modules_directory: str = "", engine: str = "host",
+                 checkpoint_path: Optional[str] = None,
+                 resume_path: Optional[str] = None):
         if isinstance(address, str):
             address = symbol_factory.BitVecVal(int(address, 16), 256)
         elif isinstance(address, int):
@@ -89,6 +91,8 @@ class SymExecWrapper:
             requires_statespace=requires_statespace,
             tx_strategy=tx_strategy,
             engine=engine,
+            checkpoint_path=checkpoint_path,
+            resume_path=resume_path,
         )
         if loop_bound is not None:
             self.laser.extend_strategy(BoundedLoopsStrategy,

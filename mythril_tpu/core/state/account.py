@@ -98,7 +98,13 @@ class Account:
         self.storage = Storage(concrete_storage, address=address,
                                dynamic_loader=dynamic_loader)
         self._balances = balances
-        self.balance = lambda: self._balances[self.address] if self._balances is not None else None
+
+    def balance(self):
+        # a method, not an instance lambda: accounts must pickle for host
+        # checkpoints (callers treat .balance as a callable, reference
+        # account.py keeps the same shape)
+        return (self._balances[self.address]
+                if self._balances is not None else None)
 
     def serialised_code(self) -> str:
         return self.code.bytecode

@@ -54,10 +54,26 @@ class LaserEVM:
                  beam_width: Optional[int] = None,
                  tx_strategy: Optional[str] = None,
                  pruning_factor: Optional[float] = None,
-                 engine: str = "host"):
+                 engine: str = "host",
+                 checkpoint_path: Optional[str] = None,
+                 resume_path: Optional[str] = None):
         #: "host" = Python worklist; "tpu" = device symbolic frontier
         #: (parallel/frontier.py) with host continuation of escaped lanes
         self.engine = engine
+        #: host-phase checkpointing (support/checkpoint.py): periodic
+        #: worklist snapshots + tx-boundary saves; device .npz rides beside
+        self.checkpoint_path = checkpoint_path
+        self.resume_path = resume_path
+        #: the device frontier reads its .npz resume point from here — the
+        #: host-resume logic consumes self.resume_path before the frontier
+        #: ever runs, so it must not share the attribute
+        self._device_resume_path = resume_path
+        self._current_tx_index = 0
+        import time as time_module
+
+        # a 0.0 sentinel vs monotonic() would force a full checkpoint pickle
+        # on the very first popped state instead of after SAVE_INTERVAL_S
+        self._last_checkpoint_time = time_module.monotonic()
         self.dynamic_loader = dynamic_loader
         self.open_states: List[WorldState] = []
         self.total_states = 0
@@ -148,7 +164,36 @@ class LaserEVM:
         predicted_hashes = self._predicted_function_hashes(address)
         if not predicted_hashes:
             predicted_hashes = self._cli_transaction_sequences()
-        for i in range(self.transaction_count):
+        start_tx, pending_work_list = 0, None
+        if self.resume_path:
+            from ..support.checkpoint import (load_host_checkpoint,
+                                              restore_into_laser)
+
+            payload = load_host_checkpoint(self.resume_path)
+            if payload is not None:
+                start_tx, pending_work_list = restore_into_laser(payload, self)
+            self.resume_path = None  # consume once
+        for i in range(start_tx, self.transaction_count):
+            self._current_tx_index = i
+            if pending_work_list is not None:
+                # mid-transaction resume: drain the restored worklist instead
+                # of opening a fresh transaction. The tx lifecycle hooks fire
+                # so plugins see the same protocol as an uninterrupted run
+                # (plugin-internal counters still restart: the dependency
+                # pruner may prune differently across a mid-tx resume; see
+                # support/checkpoint.py)
+                self.work_list.extend(pending_work_list)
+                pending_work_list = None
+                if self.work_list:
+                    log.info("resuming mid-transaction worklist, iteration: "
+                             "%d, %d states", i, len(self.work_list))
+                    for hook in self._start_sym_trans_hooks:
+                        hook()
+                    self.exec()
+                    for hook in self._stop_sym_trans_hooks:
+                        hook()
+                    self._save_checkpoint(tx_index=i + 1)
+                    continue
             if len(self.open_states) == 0:
                 log.info("no open states left, ending transaction sequence")
                 break
@@ -174,6 +219,18 @@ class LaserEVM:
                 execute_message_call(self, address, func_hashes=hashes)
             for hook in self._stop_sym_trans_hooks:
                 hook()
+            self._save_checkpoint(tx_index=i + 1)
+
+    def _save_checkpoint(self, tx_index: int, in_flight=None) -> None:
+        if not self.checkpoint_path:
+            return
+        import time as time_module
+
+        from ..support.checkpoint import save_host_checkpoint
+
+        save_host_checkpoint(self.checkpoint_path, self, tx_index,
+                             in_flight=in_flight)
+        self._last_checkpoint_time = time_module.monotonic()
 
     @staticmethod
     def _cli_transaction_sequences() -> List[Optional[List]]:
@@ -235,8 +292,19 @@ class LaserEVM:
 
     # -- main loop --------------------------------------------------------------------
     def exec(self, create: bool = False, track_gas: bool = False) -> Optional[List[GlobalState]]:
+        import time as time_module
+
+        from ..support.checkpoint import SAVE_INTERVAL_S
+
         final_states: List[GlobalState] = []
         for global_state in self.strategy:
+            if self.checkpoint_path and not create and \
+                    time_module.monotonic() - self._last_checkpoint_time \
+                    > SAVE_INTERVAL_S:
+                # periodic mid-transaction save; the popped state rides along
+                # so a kill between here and execute_state loses nothing
+                self._save_checkpoint(self._current_tx_index,
+                                      in_flight=global_state)
             if create and self.create_timeout and \
                     self.time + timedelta(seconds=self.create_timeout) <= datetime.now():
                 log.debug("hit create timeout, returning")

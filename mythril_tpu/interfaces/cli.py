@@ -66,6 +66,14 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
     options.add_argument("--beam-width", type=int, default=None)
     options.add_argument("--transaction-sequences", default=None,
                          help="explicit function-sequence list (json)")
+    options.add_argument("--checkpoint", default=None, metavar="PATH",
+                         help="periodically snapshot the analysis (host "
+                              "worklist pickle; device frontier .npz rides "
+                              "beside) so a killed run can --resume")
+    options.add_argument("--resume", default=None, metavar="PATH",
+                         help="resume a killed analysis from --checkpoint "
+                              "state; corrupt/absent checkpoints degrade to "
+                              "a fresh run")
 
     output = parser.add_argument_group("output")
     output.add_argument("-o", "--outform", default="text",

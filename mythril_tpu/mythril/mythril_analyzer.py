@@ -38,6 +38,8 @@ class MythrilAnalyzer:
         self.create_timeout = getattr(cmd, "create_timeout", 10)
         self.max_depth = getattr(cmd, "max_depth", 128)
         self.engine = getattr(cmd, "engine", "host") or "host"
+        self.checkpoint_path = getattr(cmd, "checkpoint", None)
+        self.resume_path = getattr(cmd, "resume", None)
         self.disable_dependency_pruning = getattr(
             cmd, "disable_dependency_pruning", False)
         self.custom_modules_directory = getattr(
@@ -118,7 +120,9 @@ class MythrilAnalyzer:
                     compulsory_statespace=False,
                     disable_dependency_pruning=self.disable_dependency_pruning,
                     custom_modules_directory=self.custom_modules_directory,
-                    engine=self.engine)
+                    engine=self.engine,
+                    checkpoint_path=self.checkpoint_path,
+                    resume_path=self.resume_path)
                 issues = fire_lasers(sym, modules)
             except KeyboardInterrupt:
                 log.critical("analysis interrupted, saving issues found so far")

@@ -70,6 +70,7 @@ class Arena(NamedTuple):
     c: jnp.ndarray           # int32[CAP]
     imm: jnp.ndarray         # int32[CAP]
     imm2: jnp.ndarray        # int32[CAP]
+    cls: jnp.ndarray         # int32[CAP] var-class bitmask of the node's cone
     n: jnp.ndarray           # int32[] — next free node id
     const_vals: jnp.ndarray  # uint32[CCAP, NLIMBS]
     n_const: jnp.ndarray     # int32[]
@@ -77,6 +78,13 @@ class Arena(NamedTuple):
     @property
     def capacity(self) -> int:
         return self.op.shape[0]
+
+
+#: class bitmask of conditions that must visit the host at a JUMPI so the
+#: dependence detectors (origin / predictable vars) fire with full fidelity
+PREDICTABLE_MASK = 0
+for _cls in PREDICTABLE_CLASSES | {V_ORIGIN}:
+    PREDICTABLE_MASK |= 1 << _cls
 
 
 def new_arena(capacity: int = 1 << 18, const_capacity: int = 1 << 14) -> Arena:
@@ -87,6 +95,7 @@ def new_arena(capacity: int = 1 << 18, const_capacity: int = 1 << 14) -> Arena:
         c=jnp.zeros(capacity, dtype=I32),
         imm=jnp.zeros(capacity, dtype=I32),
         imm2=jnp.zeros(capacity, dtype=I32),
+        cls=jnp.zeros(capacity, dtype=I32),
         n=jnp.asarray(1, dtype=I32),  # node 0 reserved = "concrete"
         const_vals=jnp.zeros((const_capacity, words.NLIMBS), dtype=jnp.uint32),
         n_const=jnp.asarray(0, dtype=I32),
@@ -98,12 +107,28 @@ def alloc_rows(arena: Arena, want: jnp.ndarray, op: jnp.ndarray,
                imm: jnp.ndarray, imm2: jnp.ndarray):
     """Allocate one node per lane where `want` (bool[B]); returns
     (arena', node_ids int32[B] — 0 where not wanted). Out-of-capacity lanes
-    get id 0 and must be escaped by the caller (overflow flag returned)."""
+    get id 0 and must be escaped by the caller (overflow flag returned).
+
+    The `cls` taint column is computed here: VAR nodes contribute their
+    class bit, interior nodes union their children's masks — the device
+    equivalent of the wrapper-annotation taint union (smt/bitvec.py), and
+    what lets a JUMPI decide on-device whether a condition needs a host
+    visit (detector classes) or can fork in place."""
+    op = jnp.asarray(op, dtype=I32)
+    a = jnp.asarray(a, dtype=I32)
+    b = jnp.asarray(b, dtype=I32)
+    c = jnp.asarray(c, dtype=I32)
+    imm = jnp.asarray(imm, dtype=I32)
+    imm2 = jnp.asarray(imm2, dtype=I32)
     rank = jnp.cumsum(want.astype(I32)) - 1
     ids = arena.n + rank
     overflow = want & (ids >= arena.capacity)
     ok = want & ~overflow
     slot = jnp.where(ok, ids, arena.capacity)  # OOB -> dropped write
+    var_bit = I32(1) << jnp.clip(imm, 0, 30)
+    child_cls = arena.cls[a] | arena.cls[b] | arena.cls[c]
+    cls = jnp.where(op == VAR, var_bit,
+                    jnp.where(op == CONST, 0, child_cls)).astype(I32)
     new = arena._replace(
         op=arena.op.at[slot].set(op, mode="drop"),
         a=arena.a.at[slot].set(a, mode="drop"),
@@ -111,6 +136,7 @@ def alloc_rows(arena: Arena, want: jnp.ndarray, op: jnp.ndarray,
         c=arena.c.at[slot].set(c, mode="drop"),
         imm=arena.imm.at[slot].set(imm, mode="drop"),
         imm2=arena.imm2.at[slot].set(imm2, mode="drop"),
+        cls=arena.cls.at[slot].set(cls, mode="drop"),
         n=jnp.minimum(arena.n + jnp.sum(want.astype(I32)),
                       arena.capacity).astype(I32),
     )

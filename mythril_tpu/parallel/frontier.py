@@ -6,14 +6,17 @@ Every open world state seeds one device lane (pc=0, symbolic calldata/env,
 storage table from the world state); the batch runs fused symbolic steps
 (parallel/symstep.py) until lanes pause or leave:
 
-  - FORKING lanes (symbolic JUMPI) are serviced on host: the lane is
-    duplicated into a free slot, each side gets one path-constraint node, and
-    both sides are feasibility-checked through the incremental solver — the
-    shared constraint prefix makes consecutive checks nearly free
-    (smt/solver/incremental.py).
-  - Conditions containing tx.origin or block attributes are NOT forked on
-    device: the lane is handed to the host at the JUMPI so the dependence
-    detectors see it exactly as in host-only exploration.
+  - Symbolic JUMPIs fork ON DEVICE (symstep.sym_step's fork block): the lane
+    claims a DEAD lane, both sides append a signed condition id, and the pair
+    keeps stepping inside the same fused loop — no host service, no batch
+    round-trip. Feasibility is deferred to materialization (the
+    DelayConstraint "pending" pattern): the incremental solver checks each
+    lane's condition set once, when it leaves the device. Saturated forkers
+    WAIT frozen and the fork block revives them as escapes free lanes; a
+    full-batch deadlock hands the wave to the host.
+  - Conditions whose taint cone (arena cls bitmask) contains tx.origin or
+    block attributes are NOT forked on device: the lane escapes at the JUMPI
+    so the dependence detectors see it exactly as in host-only exploration.
   - ESCAPED lanes (CALL family, SELFDESTRUCT, keccak over symbolic bytes,
     RETURN/STOP/REVERT, ...) are materialized into full host GlobalStates —
     stack/memory/storage/path conditions rebuilt as terms — and pushed onto
@@ -96,7 +99,6 @@ class _Frontier:
         self.laser = laser_evm
         self.n_lanes = n_lanes
         self.contexts: List[LaneContext] = []
-        self.lane_ctx = np.full(n_lanes, -1, dtype=np.int64)
         self.arena = A.new_arena()
         self.materialized = 0
         self.forks = 0
@@ -149,32 +151,66 @@ class _Frontier:
         storage_sym = np.zeros((self.n_lanes,
                                 state.storage_keys.shape[1]), dtype=np.int32)
         storage_base_sym = np.zeros(self.n_lanes, dtype=bool)
+        ctx_id = np.full(self.n_lanes, -1, dtype=np.int32)
         for lane, (template, entries, base_sym, _code) in enumerate(specs):
             storage_base_sym[lane] = base_sym
             tx, _ = template.transaction_stack[-1]
             ctx = LaneContext(str(tx.id), template.environment.calldata,
                               template.environment, template)
             self.contexts.append(ctx)
-            self.lane_ctx[lane] = len(self.contexts) - 1
+            ctx_id[lane] = len(self.contexts) - 1
             # symbolic storage values ride in as host-term leaves
             for key, value in entries:
                 if value.raw.is_const:
                     continue
-                ctx.host_terms.append(value)
-                self.arena, node, _ovf = A.alloc_rows(
-                    self.arena,
-                    np.asarray([True]), np.asarray([A.VAR], dtype=np.int32),
-                    np.asarray([0], dtype=np.int32),
-                    np.asarray([0], dtype=np.int32),
-                    np.asarray([0], dtype=np.int32),
-                    np.asarray([A.V_HOST_TERM], dtype=np.int32),
-                    np.asarray([len(ctx.host_terms) - 1], dtype=np.int32))
+                node = self._alloc_host_term(ctx, value)
+                if node is None:
+                    continue
                 slot = self._storage_slot_of(state, lane, key)
                 if slot is not None:
-                    storage_sym[lane, slot] = int(node[0])
+                    storage_sym[lane, slot] = node
         planes = planes._replace(storage_sym=np.asarray(storage_sym),
-                                 storage_base_sym=np.asarray(storage_base_sym))
+                                 storage_base_sym=np.asarray(storage_base_sym),
+                                 ctx_id=np.asarray(ctx_id))
         return state, planes
+
+    def _alloc_host_term(self, ctx: "LaneContext", value) -> Optional[int]:
+        """Park an arbitrary host BitVec as a V_HOST_TERM arena leaf; the
+        leaf's taint-class bits include any detector annotations riding on
+        the term (origin/predictable taint persisted through storage must
+        still force a host visit at a dependent JUMPI)."""
+        ctx.host_terms.append(value)
+        self.arena, node, overflow = A.alloc_rows(
+            self.arena,
+            np.asarray([True]), np.asarray([A.VAR], dtype=np.int32),
+            np.asarray([0], dtype=np.int32),
+            np.asarray([0], dtype=np.int32),
+            np.asarray([0], dtype=np.int32),
+            np.asarray([A.V_HOST_TERM], dtype=np.int32),
+            np.asarray([len(ctx.host_terms) - 1], dtype=np.int32))
+        if bool(overflow[0]):
+            return None
+        extra_bits = self._annotation_class_bits(value)
+        if extra_bits:
+            node_index = int(node[0])
+            self.arena = self.arena._replace(
+                cls=self.arena.cls.at[node_index].set(
+                    int(self.arena.cls[node_index]) | extra_bits))
+        return int(node[0])
+
+    @staticmethod
+    def _annotation_class_bits(value) -> int:
+        from ..analysis.modules.dependence_on_origin import OriginAnnotation
+        from ..analysis.modules.dependence_on_predictable_vars import \
+            PredictableValueAnnotation
+
+        bits = 0
+        for annotation in getattr(value, "annotations", ()):
+            if isinstance(annotation, OriginAnnotation):
+                bits |= 1 << A.V_ORIGIN
+            elif isinstance(annotation, PredictableValueAnnotation):
+                bits |= 1 << A.V_TIMESTAMP
+        return bits
 
     @staticmethod
     def _storage_slot_of(state: StateBatch, lane: int, key: int
@@ -196,8 +232,17 @@ class _Frontier:
         from ..core.time_handler import time_handler
 
         max_steps = int(os.environ.get("MYTHRIL_TPU_MAX_STEPS", MAX_STEPS))
-        checkpoint_path = os.environ.get("MYTHRIL_TPU_CHECKPOINT")
-        resume_path = os.environ.get("MYTHRIL_TPU_RESUME")
+        chunk = int(os.environ.get("MYTHRIL_TPU_CHUNK", CHUNK))
+        # env vars keep working; `analyze --checkpoint/--resume` rides the
+        # laser's host-phase paths with a .device suffix beside the pickle
+        host_ckpt = getattr(self.laser, "checkpoint_path", None)
+        # NOT laser.resume_path: the host-resume logic consumes that before
+        # the frontier runs (svm.execute_transactions)
+        host_resume = getattr(self.laser, "_device_resume_path", None)
+        checkpoint_path = os.environ.get("MYTHRIL_TPU_CHECKPOINT") \
+            or (f"{host_ckpt}.device" if host_ckpt else None)
+        resume_path = os.environ.get("MYTHRIL_TPU_RESUME") \
+            or (f"{host_resume}.device" if host_resume else None)
         if resume_path:
             if not resume_path.endswith(".npz"):
                 resume_path += ".npz"
@@ -210,29 +255,46 @@ class _Frontier:
                     log.warning("cannot resume from %s (%s); starting the "
                                 "device phase fresh", resume_path, error)
                 os.environ.pop("MYTHRIL_TPU_RESUME", None)  # consume once
+                self.laser._device_resume_path = None
         steps = 0
         services = 0
+        # ONE jit signature: numpy rows written by host services must be
+        # re-canonicalized to device arrays, or the next fused call sees a
+        # host-placed argument signature and XLA recompiles the whole step
+        # (~50s on the remote-TPU path — measured eating the entire bench
+        # budget mid-run)
+        state, planes = self._to_device(state, planes)
+        iteration = 0
         while steps < max_steps:
-            if int(self.arena.n) > self.arena.capacity - ARENA_HEADROOM:
+            # the headroom pull is a device->host scalar sync; CHUNK-sized
+            # allocation bursts cannot overrun ARENA_HEADROOM in 8 chunks
+            if iteration % 8 == 0 and \
+                    int(self.arena.n) > self.arena.capacity - ARENA_HEADROOM:
                 log.warning("arena head-room exhausted; handing remaining "
                             "lanes to the host")
                 break
+            iteration += 1
             if time_handler.time_remaining() <= 1000:  # ms
                 log.info("execution budget exhausted; ending device phase")
                 break
-            live_before = np.asarray(state.status) == RUNNING
+            status_before = np.asarray(state.status)
+            live_before = status_before == RUNNING
             state, planes, self.arena = symstep.sym_step_many(
-                state, planes, self.arena, CHUNK)
-            steps += CHUNK
+                state, planes, self.arena, chunk)
+            steps += chunk
             status = np.asarray(state.status)
             # precise accounting: lanes that left mid-chunk (fork/escape/halt)
             # froze after >=1 step — credit 1, not CHUNK
             still_live = status == RUNNING
-            self.lane_steps += int(np.sum(live_before & still_live)) * CHUNK \
+            self.lane_steps += int(np.sum(live_before & still_live)) * chunk \
                 + int(np.sum(live_before & ~still_live))
+            # device forks = DEAD lanes claimed as fork targets (a revived
+            # frozen forker is the SAME path continuing, not a new fork)
+            self.forks += int(np.sum((status_before == DEAD) & still_live))
             if (status == FORKING).any() or (status == ESCAPED).any() \
                     or not (status == RUNNING).any():
                 state, planes = self._service(state, planes)
+                state, planes = self._to_device(state, planes)
                 status = np.asarray(state.status)
                 services += 1
                 if checkpoint_path and services % 8 == 0:
@@ -242,15 +304,44 @@ class _Frontier:
         # budget exhausted: surviving lanes continue on host
         self._hand_over_running(state, planes)
 
+    @staticmethod
+    def _to_device(state: StateBatch, planes: symstep.SymPlanes):
+        import jax
+
+        # ONE batched async transfer for the whole pytree: 40+ sequential
+        # per-field puts each paid a full round-trip on the remote-TPU
+        # tunnel (~12s of dead time per seeding at 512 lanes)
+        return jax.device_put((state, planes))
+
+    def _materialize_lanes(self, state: StateBatch, planes, harena,
+                           lanes) -> None:
+        """Batched materialization: gather the selected lanes' rows on
+        device, fetch them in one transfer, and materialize each row."""
+        import jax
+
+        index = np.asarray(lanes)
+        rows_state, rows_planes = jax.device_get(
+            jax.tree_util.tree_map(lambda leaf: leaf[index], (state, planes)))
+        state_rows = {field: np.asarray(getattr(rows_state, field))
+                      for field in rows_state._fields}
+        planes_rows = {field: np.asarray(getattr(rows_planes, field))
+                       for field in rows_planes._fields}
+        for row in range(len(index)):
+            self._materialize_np(state_rows, planes_rows, harena, row)
+
     def _service(self, state: StateBatch, planes: symstep.SymPlanes):
         """Harvest escaped/halted lanes, fork paused lanes, prune unsat."""
         status = np.array(state.status)  # writable copy
         harena = A.HostArena(self.arena)
 
-        # harvest: escaped lanes go to the host worklist
-        for lane in np.nonzero(status == ESCAPED)[0]:
-            self._materialize(state, planes, harena, int(lane))
-            status[lane] = DEAD
+        # harvest: escaped lanes go to the host worklist. Their rows are
+        # gathered ON DEVICE and fetched in one batched transfer — per-lane
+        # per-field pulls cost 44 tunnel round-trips per escape and
+        # serialized the whole bench into materialization time
+        escaped = np.nonzero(status == ESCAPED)[0]
+        if len(escaped):
+            self._materialize_lanes(state, planes, harena, escaped)
+            status[escaped] = DEAD
         # halted/errored lanes are done (the device executed STOP/RETURN/
         # REVERT only via escape, so these are bookkeeping-only states)
         for lane in np.nonzero((status == ERRORED))[0]:
@@ -258,84 +349,37 @@ class _Frontier:
 
         forking = np.nonzero(status == FORKING)[0]
         if len(forking):
-            # np.asarray over device arrays yields read-only views; the fork
-            # service mutates lanes in place, so take writable copies
-            state_np = {field: np.array(getattr(state, field))
-                        for field in state._fields}
-            planes_np = {field: np.array(getattr(planes, field))
-                         for field in planes._fields}
-            for lane in forking:
-                # dispatch on the frozen opcode: SLOAD = cold storage
-                # fault-in, JUMPI = path fork
-                pc = int(state_np["pc"][lane])
-                opcode = int(state_np["code"][lane, pc]) \
-                    if pc < int(state_np["code_len"][lane]) else 0
-                if opcode == 0x54:  # SLOAD
+            # fork_cond == 0 marks a cold-SLOAD pause (needs the host
+            # fault-in service); != 0 marks a saturated forker WAITING for a
+            # free lane — those stay frozen: the device fork block revives
+            # them itself once escapes free capacity (round-3 lesson: host-
+            # servicing every saturated forker serialized the whole bench
+            # into per-lane solver calls)
+            fork_conds = np.asarray(planes.fork_cond)
+            cold = [int(lane) for lane in forking if fork_conds[lane] == 0]
+            if cold:
+                state_np = {field: np.array(getattr(state, field))
+                            for field in state._fields}
+                planes_np = {field: np.array(getattr(planes, field))
+                             for field in planes._fields}
+                for lane in cold:
                     self._cold_sload_lane(state_np, planes_np, harena,
-                                          status, int(lane))
-                else:
-                    self._fork_lane(state_np, planes_np, harena, status,
-                                    int(lane))
-            state = StateBatch(**{f: state_np[f] for f in state._fields})
-            planes = symstep.SymPlanes(**{f: planes_np[f]
-                                          for f in planes._fields})
+                                          status, lane)
+                state = StateBatch(**{f: state_np[f]
+                                      for f in state._fields})
+                planes = symstep.SymPlanes(**{f: planes_np[f]
+                                              for f in planes._fields})
+            waiting = [int(lane) for lane in forking
+                       if fork_conds[lane] != 0]
+            # deadlock: every lane is a waiting forker and nothing can free
+            # capacity — hand the whole wave to the host (it explores both
+            # branch sides from the frozen JUMPI)
+            if waiting and not (status == RUNNING).any() \
+                    and not (status == DEAD).any():
+                self._materialize_lanes(state, planes, harena, waiting)
+                status[np.asarray(waiting)] = DEAD
         state = state._replace(status=np.asarray(status))
         return state, planes
-
-    def _fork_lane(self, state_np, planes_np, harena, status, lane: int):
-        ctx = self.contexts[self.lane_ctx[lane]]
-        cond_node = int(planes_np["fork_cond"][lane])
-        classes = harena.var_classes(cond_node)
-        if classes & (A.PREDICTABLE_CLASSES | {A.V_ORIGIN}):
-            # dependence detectors must see this JUMPI on host
-            self._materialize_np(state_np, planes_np, harena, lane,
-                                 status_override=None)
-            status[lane] = DEAD
-            return
-        free = np.nonzero(status == DEAD)[0]
-        count = int(planes_np["cond_count"][lane])
-        if not len(free) or count + 1 > MAX_CONDS:
-            self._materialize_np(state_np, planes_np, harena, lane)
-            status[lane] = DEAD
-            return
-        target = int(free[0])
-        self.forks += 1
-
-        # duplicate the lane
-        for field, table in state_np.items():
-            table[target] = table[lane]
-        for field, table in planes_np.items():
-            table[target] = table[lane]
-        self.lane_ctx[target] = self.lane_ctx[lane]
-
-        # taken side: pc = dest (already on the stack top), constraint +node
-        from . import words
-
-        sp = int(state_np["sp"][lane])
-        fork_pc = int(state_np["pc"][lane])  # before either side mutates it
-        dest = int(words.to_ints(state_np["stack"][lane, sp - 1]))
-        code_cap = state_np["code"].shape[1]
-        dest_ok = 0 <= dest < code_cap and bool(state_np["jumpdest"][lane, dest])
-
-        for side, is_taken in ((lane, True), (target, False)):
-            state_np["sp"][side] = sp - 2
-            planes_np["stack_sym"][side, sp - 2:] = 0
-            planes_np["fork_cond"][side] = 0
-            if is_taken:
-                if not dest_ok:
-                    status[side] = DEAD  # invalid destination branch
-                    continue
-                state_np["pc"][side] = dest
-            else:
-                state_np["pc"][side] = fork_pc + 1
-            signed = cond_node if is_taken else -cond_node
-            planes_np["conds"][side, count] = signed
-            planes_np["cond_count"][side] = count + 1
-            if self._feasible(planes_np, harena, side):
-                status[side] = RUNNING
-            else:
-                status[side] = DEAD
-                self.infeasible += 1
 
     def _cold_sload_lane(self, state_np, planes_np, harena, status,
                          lane: int) -> None:
@@ -346,7 +390,7 @@ class _Frontier:
         arena leaf, inserts the slot, and resumes the lane on device."""
         from . import words
 
-        ctx = self.contexts[self.lane_ctx[lane]]
+        ctx = self.contexts[int(planes_np["ctx_id"][lane])]
         sp = int(state_np["sp"][lane])
         key = int(words.to_ints(state_np["stack"][lane, sp - 1]))
         used = state_np["storage_used"][lane]
@@ -367,23 +411,15 @@ class _Frontier:
                 words.from_int(value.raw.value))
             planes_np["storage_sym"][lane, slot] = 0
         else:
-            ctx.host_terms.append(value)
-            self.arena, node, overflow = A.alloc_rows(
-                self.arena,
-                np.asarray([True]), np.asarray([A.VAR], dtype=np.int32),
-                np.asarray([0], dtype=np.int32),
-                np.asarray([0], dtype=np.int32),
-                np.asarray([0], dtype=np.int32),
-                np.asarray([A.V_HOST_TERM], dtype=np.int32),
-                np.asarray([len(ctx.host_terms) - 1], dtype=np.int32))
-            if bool(overflow[0]):
+            node = self._alloc_host_term(ctx, value)
+            if node is None:
                 # arena exhausted: node id 0 would silently read as
                 # "concrete" — hand the lane to the host instead
                 state_np["storage_used"][lane, slot] = False
                 self._materialize_np(state_np, planes_np, harena, lane)
                 status[lane] = DEAD
                 return
-            planes_np["storage_sym"][lane, slot] = int(node[0])
+            planes_np["storage_sym"][lane, slot] = node
         # a fault-in is a READ: dirty stays False, materialization will not
         # write Select(base, key) back over the template's storage
         planes_np["storage_dirty"][lane, slot] = False
@@ -391,7 +427,7 @@ class _Frontier:
         status[lane] = RUNNING
 
     def _cond_bools(self, planes_np, harena, lane: int) -> List[Bool]:
-        ctx = self.contexts[self.lane_ctx[lane]]
+        ctx = self.contexts[int(planes_np["ctx_id"][lane])]
         bools: List[Bool] = []
         for position in range(int(planes_np["cond_count"][lane])):
             signed = int(planes_np["conds"][lane, position])
@@ -405,7 +441,7 @@ class _Frontier:
         from ..exceptions import SolverTimeOutException
         from ..support.model import get_model
 
-        ctx = self.contexts[self.lane_ctx[lane]]
+        ctx = self.contexts[int(planes_np["ctx_id"][lane])]
         constraints = Constraints(
             list(ctx.template.world_state.constraints)
             + self._cond_bools(planes_np, harena, lane))
@@ -423,21 +459,18 @@ class _Frontier:
 
     # -- materialization ---------------------------------------------------------------
 
-    def _materialize(self, state: StateBatch, planes, harena, lane: int):
-        state_np = {field: np.asarray(getattr(state, field)[lane])[None]
-                    for field in state._fields}
-        planes_np = {field: np.asarray(getattr(planes, field)[lane])[None]
-                     for field in planes._fields}
-        self._materialize_np(state_np, planes_np, harena, 0,
-                             real_lane=lane)
-
-    def _materialize_np(self, state_np, planes_np, harena, lane: int,
-                        status_override=None, real_lane: Optional[int] = None):
+    def _materialize_np(self, state_np, planes_np, harena, lane: int):
         from . import words
         from ..smt import BitVec
 
-        ctx = self.contexts[self.lane_ctx[real_lane
-                                          if real_lane is not None else lane]]
+        ctx = self.contexts[int(planes_np["ctx_id"][lane])]
+        # pending-style pruning: device forks are optimistic (no per-fork
+        # solver call); the one feasibility check happens here, where the
+        # lane leaves the device (SURVEY §7 stage 9)
+        if int(planes_np["cond_count"][lane]) > 0 and \
+                not self._feasible(planes_np, harena, lane):
+            self.infeasible += 1
+            return
         template = ctx.template
         global_state = copy(template)
         mstate = global_state.mstate
@@ -541,7 +574,7 @@ class _Frontier:
             arrays[f"planes_{field}"] = np.asarray(getattr(planes, field))
         used = int(self.arena.n)
         used_const = int(self.arena.n_const)
-        for field in ("op", "a", "b", "c", "imm", "imm2"):
+        for field in ("op", "a", "b", "c", "imm", "imm2", "cls"):
             arrays[f"arena_{field}"] = np.asarray(
                 getattr(self.arena, field))[:used]
         arrays["arena_const_vals"] = np.asarray(
@@ -549,7 +582,6 @@ class _Frontier:
         arrays["arena_caps"] = np.asarray(
             [self.arena.capacity, self.arena.const_vals.shape[0],
              used, used_const])
-        arrays["lane_ctx"] = self.lane_ctx
         arrays["counters"] = np.asarray(
             [self.forks, self.infeasible, self.materialized, self.lane_steps])
         arrays["identity"] = np.asarray(
@@ -582,7 +614,7 @@ class _Frontier:
                                             for v in data["arena_caps"])
         arena = A.new_arena(capacity=cap, const_capacity=const_cap)
         fields = {}
-        for field in ("op", "a", "b", "c", "imm", "imm2"):
+        for field in ("op", "a", "b", "c", "imm", "imm2", "cls"):
             full = np.zeros(cap, dtype=np.int32)
             full[:used] = data[f"arena_{field}"]
             fields[field] = full
@@ -591,16 +623,26 @@ class _Frontier:
         self.arena = arena._replace(
             n=np.int32(used), n_const=np.int32(used_const),
             const_vals=const_vals, **fields)
-        self.lane_ctx = data["lane_ctx"]
         self.forks, self.infeasible, self.materialized, self.lane_steps = (
             int(v) for v in data["counters"])
         return state, planes
 
     def _hand_over_running(self, state: StateBatch, planes) -> None:
+        from ..core.time_handler import time_handler
+
         status = np.asarray(state.status)
+        live = np.nonzero((status == RUNNING) | (status == FORKING))[0]
+        if time_handler.time_remaining() <= 1000 and len(live):
+            # execution budget exhausted: the host could not explore these
+            # states either (its own timeout drops mid-worklist states the
+            # same way) — and each materialization costs a solver
+            # feasibility check, which serialized into minutes at the end
+            # of a timed run
+            log.info("execution budget exhausted with %d live lanes; "
+                     "dropping them (host-timeout parity)", len(live))
+            return
         harena = A.HostArena(self.arena)
-        for lane in np.nonzero((status == RUNNING) | (status == FORKING))[0]:
-            self._materialize(state, planes, harena, int(lane))
+        self._materialize_lanes(state, planes, harena, live)
 
 
 def execute_message_call_tpu(laser_evm, callee_address,
@@ -684,4 +726,9 @@ def execute_message_call_tpu(laser_evm, callee_address,
         laser_evm, "frontier_lane_steps", 0) + frontier.lane_steps
     laser_evm.frontier_forks = getattr(
         laser_evm, "frontier_forks", 0) + frontier.forks
+    if os.environ.get("MYTHRIL_TPU_SKIP_HOST_DRAIN"):
+        # warm-up aid (bench.py): compile/load the device executable without
+        # paying a full host continuation of the materialized states
+        del laser_evm.work_list[:]
+        return
     laser_evm.exec()

@@ -93,6 +93,7 @@ class SymPlanes(NamedTuple):
     cond_count: jnp.ndarray    # int32[B]
     fork_cond: jnp.ndarray     # int32[B] node id pending at a FORKING lane
     symbolic_env: jnp.ndarray  # bool[B] env/calldata are symbolic
+    ctx_id: jnp.ndarray        # int32[B] seeding-context index (rides forks)
 
     @classmethod
     def empty(cls, batch: int, stack_slots: int, mem_bytes: int,
@@ -107,6 +108,7 @@ class SymPlanes(NamedTuple):
             cond_count=jnp.zeros(batch, dtype=I32),
             fork_cond=jnp.zeros(batch, dtype=I32),
             symbolic_env=jnp.ones(batch, dtype=bool),
+            ctx_id=jnp.full(batch, -1, dtype=I32),
         )
 
 
@@ -198,6 +200,22 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena
 
     # ---- classify: FORK / PAUSE -----------------------------------------------------
     jumpi_sym_cond = running & is_op("JUMPI") & (sym2 != 0) & (sym1 == 0)
+    # conditions whose taint cone contains origin/block-attribute classes
+    # must visit the host at the JUMPI (dependence detectors hook it); all
+    # other symbolic conditions fork ON DEVICE below — no host service, no
+    # batch round-trip through the tunnel (the round-3 bench stall was
+    # per-fork full-batch transfers)
+    cond_cls = arena.cls[jnp.clip(sym2, 0, arena.capacity - 1)]
+    cond_room = planes.cond_count + 1 <= planes.conds.shape[1]
+    jumpi_host = jumpi_sym_cond & (((cond_cls & A.PREDICTABLE_MASK) != 0)
+                                   | ~cond_room)
+    jumpi_fork = jumpi_sym_cond & ~jumpi_host
+    # saturated forkers WAIT frozen (status FORKING) and are revived here
+    # once escapes free lanes: their pc still sits on the JUMPI, so the
+    # same decode re-classifies them each step
+    frozen_fork = (state.status == FORKING) & is_op("JUMPI") \
+        & (sym2 != 0) & (sym1 == 0) & cond_room \
+        & ((cond_cls & A.PREDICTABLE_MASK) == 0)
     # cold SLOAD on a symbolic-base storage: the key is concrete but absent
     # from the device table — pause the lane (status FORKING, pc still at the
     # SLOAD) so the driver can fault the slot in as a Select(base, key)
@@ -206,7 +224,7 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena
     # re-expressed as a host service)
     sload_cold = sload_mask & (sym1 == 0) & planes.storage_base_sym \
         & ~storage_found
-    force_fork = jumpi_sym_cond | sload_cold
+    force_fork = jumpi_fork | sload_cold
 
     # ---- classify: ESCAPE -----------------------------------------------------------
     sym_representable = SYM_OK_T[op] | PLUMBING_T[op]
@@ -221,6 +239,7 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena
     # memory ops with symbolic offsets/sizes
     esc = esc | (running & is_op("JUMP") & (sym1 != 0))
     esc = esc | (running & is_op("JUMPI") & (sym1 != 0))   # symbolic dest
+    esc = esc | jumpi_host  # detector-relevant branch condition
     esc = esc | (running & is_op("MSTORE") & (sym1 != 0))
     esc = esc | (running & is_op("MLOAD") & (sym1 != 0))
     esc = esc | cdl_sym_off
@@ -346,15 +365,90 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena
         jnp.where(sstore_any, new_slot, 0)].set(
         jnp.where(sstore_any, True, False), mode="drop")
 
-    # fork condition for JUMPI-paused lanes (cold-SLOAD pauses carry none:
-    # the driver dispatches on the opcode under the frozen pc)
-    fork_cond = jnp.where((state.status == RUNNING) & jumpi_sym_cond, sym2,
-                          new_planes.fork_cond)
+    # fork condition marks WAITING forkers for the driver; a cold-SLOAD
+    # pause must CLEAR it (a stale node from the lane's previous fork would
+    # misclassify the pause and strand the lane — the driver dispatches on
+    # fork_cond == 0 for the fault-in service)
+    fork_cond = jnp.where(
+        (state.status == RUNNING) & jumpi_fork, sym2,
+        jnp.where((state.status == RUNNING) & sload_cold, 0,
+                  new_planes.fork_cond))
 
     new_planes = new_planes._replace(mem_sym=mem_sym,
                                      storage_sym=storage_sym,
                                      storage_dirty=storage_dirty,
                                      fork_cond=fork_cond)
+
+    # ---- on-device JUMPI forking ----------------------------------------------------
+    # Forking lanes claim a DEAD lane each: the pair continues in the same
+    # fused loop with signed condition ids appended — no host service, no
+    # deepcopy (reference forks at instructions.py:1633,1658 via deepcopy).
+    # Feasibility is NOT checked here: lanes explore optimistically and the
+    # driver prunes unsat paths once, at materialization (the DelayConstraint
+    # "pending" pattern, SURVEY §7 stage 9, on device).
+    max_conds = planes.conds.shape[1]
+    want = jumpi_fork | frozen_fork  # cond_room baked into both
+    is_dead = new_state.status == DEAD
+    dead_rank = jnp.cumsum(is_dead.astype(I32)) - 1
+    dead_map = jnp.zeros(batch, dtype=I32).at[
+        jnp.where(is_dead, dead_rank, batch)].set(
+        lane.astype(I32), mode="drop")
+    fork_rank = jnp.cumsum(want.astype(I32)) - 1
+    n_dead = jnp.sum(is_dead.astype(I32))
+    have_target = want & (fork_rank < n_dead)
+    target = jnp.where(have_target,
+                       dead_map[jnp.clip(fork_rank, 0, batch - 1)],
+                       batch).astype(I32)
+
+    # taken-side destination validity (dest = concrete stack top)
+    code_cap = state.code.shape[1]
+    dest_in = off_fits & (off_i >= 0) & (off_i < state.code_len)
+    dest_bitmap = jnp.take_along_axis(
+        state.jumpdest, jnp.clip(off_i, 0, code_cap - 1)[:, None].astype(I32),
+        axis=1)[:, 0]
+    dest_ok = dest_in & dest_bitmap
+
+    count = jnp.clip(planes.cond_count, 0, max_conds - 1)
+
+    # 1. prepare the forker row as the shared post-fork template: sp -= 2,
+    #    gas charged, +cond appended, dead stack_sym slots cleared
+    sp_fork = jnp.where(have_target, state.sp - 2, new_state.sp)
+    gas_fork = jnp.where(have_target,
+                         state.gas_used + lockstep.GAS_MIN_T[op],
+                         new_state.gas_used)
+    conds_fork = new_planes.conds.at[
+        jnp.where(have_target, lane, batch), count].set(sym2, mode="drop")
+    ccount_fork = jnp.where(have_target, planes.cond_count + 1,
+                            new_planes.cond_count)
+    j_slots = jnp.arange(slots)
+    cleared = have_target[:, None] & (j_slots[None, :] >= sp_fork[:, None])
+    ssym_fork = jnp.where(cleared, 0, new_planes.stack_sym)
+    state_a = new_state._replace(sp=sp_fork, gas_used=gas_fork)
+    planes_a = new_planes._replace(conds=conds_fork, cond_count=ccount_fork,
+                                   stack_sym=ssym_fork)
+
+    # 2. duplicate the prepared rows into the claimed target lanes
+    state_b = StateBatch(*[
+        field.at[target].set(field, mode="drop") for field in state_a])
+    planes_b = SymPlanes(*[
+        field.at[target].set(field, mode="drop") for field in planes_a])
+
+    # 3. per-side divergence: forker takes the jump, target falls through;
+    #    the target's appended condition flips sign
+    pc_final = jnp.where(have_target, off_i.astype(I32), state_b.pc)
+    status_final = jnp.where(
+        have_target, jnp.where(dest_ok, RUNNING, DEAD), state_b.status)
+    pc_final = pc_final.at[target].set(state.pc + 1, mode="drop")
+    status_final = status_final.at[target].set(I32(RUNNING), mode="drop")
+    conds_final = planes_b.conds.at[target, count].set(-sym2, mode="drop")
+    # the fork is consumed: clear the waiting marker on BOTH sides (a stale
+    # marker would misclassify this lane's next pause as a fork-wait)
+    fcond_final = jnp.where(have_target, 0, planes_b.fork_cond)
+    fcond_final = fcond_final.at[target].set(0, mode="drop")
+
+    new_state = state_b._replace(pc=pc_final, status=status_final)
+    new_planes = planes_b._replace(conds=conds_final,
+                                   fork_cond=fcond_final)
     return new_state, new_planes, arena
 
 

@@ -86,6 +86,22 @@ def _get_pipeline():
     return _pipeline
 
 
+def reset_solver_backend() -> None:
+    """Drop the process-wide incremental pipeline and the model caches.
+
+    Per-query cost grows with the monotone pool (the session re-propagates
+    its whole trail); a fresh analysis — or a test that asserts exact
+    sat/unsat behavior — can call this to shed state accumulated by earlier
+    heavy workloads."""
+    global _pipeline
+    if _pipeline is not None:
+        _pipeline.close()
+        _pipeline = None
+    from ...support import model as model_service
+
+    model_service.reset_model_caches()
+
+
 def check_formulas(raw_constraints: List[terms.Term],
                    max_conflicts: int = 2_000_000,
                    timeout_ms: int = 0) -> Tuple[str, Optional[Model]]:
@@ -264,11 +280,16 @@ class Optimize(BaseSolver):
                         high = min(value, mid)
                     else:
                         low = max(value, mid)
-                else:
+                elif probe_status == "unsat":
                     if is_minimize:
                         low = mid + 1
                     else:
                         high = mid - 1
+                else:
+                    # "unknown" teaches nothing: narrowing on it would
+                    # mislabel a reachable optimum as excluded — keep the
+                    # best model found and stop searching this objective
+                    break
             # pin the reached optimum so later objectives respect earlier ones
             final = model.eval(obj_raw)
             bound_terms.append(terms.bv_cmp("eq", obj_raw,

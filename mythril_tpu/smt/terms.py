@@ -41,6 +41,9 @@ class ArraySort:
     def __repr__(self):
         return f"Array({self.index_width}->{self.value_width})"
 
+    def __reduce__(self):
+        return (ArraySort, (self.index_width, self.value_width))
+
 
 # A sort is: int (bit-vector width), BOOL, or an ArraySort instance.
 
@@ -106,6 +109,13 @@ class Term:
 
     def __deepcopy__(self, memo):
         return self
+
+    def __reduce__(self):
+        # pickle round-trips MUST re-intern: identity is equality here, so a
+        # naively reconstructed duplicate would break every constraint-set /
+        # cache lookup after a checkpoint resume (frontier host-phase
+        # checkpoints pickle whole GlobalStates)
+        return (Term, (self.op, self.args, self.params, self.sort))
 
     @property
     def is_const(self) -> bool:

@@ -1,0 +1,116 @@
+"""Host-phase analysis checkpoints.
+
+The reference has NO engine-state serialization (SURVEY §5 assigns
+checkpoint/resume to this build as a fresh design). The device frontier has
+dense .npz snapshots (parallel/frontier.py save_checkpoint); this module
+covers the phase where most analyses actually live: the host worklist.
+
+What a checkpoint holds: the open world states, the pending worklist (plus
+the in-flight state at a mid-transaction save), the transaction index, and
+the CALLBACK detectors' accumulated issues/caches — everything needed for a
+killed `analyze` to resume and emit the identical final report. GlobalStates
+are plain Python object graphs and the term DAG re-interns on unpickle
+(smt/terms.py Term.__reduce__), so pickle is sufficient and exact.
+
+Writes are atomic (tmp + os.replace): preemption mid-write never corrupts
+the only checkpoint.
+
+Known limit: laser-plugin INTERNAL state (e.g. the dependency pruner's
+per-iteration counters) is not serialized — a mid-transaction resume
+re-fires the tx lifecycle hooks but plugin counters restart, so pruning
+heuristics may explore slightly differently than the uninterrupted run;
+detector issues and tx-boundary resumes are exact.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import sys
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+FORMAT_VERSION = 1
+#: seconds between periodic mid-transaction saves
+SAVE_INTERVAL_S = 15.0
+
+
+def _collect_detector_state():
+    from ..analysis.module.loader import ModuleLoader
+
+    state = {}
+    for module in ModuleLoader().get_detection_modules():
+        state[module.name] = {
+            "issues": list(module.issues),
+            "cache": set(getattr(module, "cache", ()) or ()),
+        }
+    return state
+
+
+def _restore_detector_state(state) -> None:
+    from ..analysis.module.loader import ModuleLoader
+
+    for module in ModuleLoader().get_detection_modules():
+        saved = state.get(module.name)
+        if saved is None:
+            continue
+        module.issues = list(saved["issues"])
+        if hasattr(module, "cache"):
+            module.cache = set(saved["cache"])
+
+
+def save_host_checkpoint(path: str, laser, tx_index: int,
+                         in_flight=None) -> None:
+    payload = {
+        "version": FORMAT_VERSION,
+        "tx_index": tx_index,
+        "open_states": list(laser.open_states),
+        "work_list": ([in_flight] if in_flight is not None else [])
+        + list(laser.work_list),
+        "executed_nodes": laser.executed_nodes,
+        "total_states": laser.total_states,
+        "detectors": _collect_detector_state(),
+    }
+    limit = sys.getrecursionlimit()
+    sys.setrecursionlimit(max(limit, 200_000))  # deep store/constraint chains
+    try:
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as handle:
+            pickle.dump(payload, handle, protocol=4)
+        os.replace(tmp, path)
+    finally:
+        sys.setrecursionlimit(limit)
+
+
+def load_host_checkpoint(path: str) -> Optional[dict]:
+    """Returns the payload, or None when the file is absent/corrupt/foreign
+    (a bad checkpoint must degrade to a fresh run, never crash the run)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as handle:
+            payload = pickle.load(handle)
+        if payload.get("version") != FORMAT_VERSION:
+            log.warning("checkpoint %s has format %s (want %s); ignoring",
+                        path, payload.get("version"), FORMAT_VERSION)
+            return None
+        return payload
+    except Exception as error:
+        log.warning("cannot load checkpoint %s (%s); starting fresh",
+                    path, error)
+        return None
+
+
+def restore_into_laser(payload: dict, laser) -> tuple:
+    """Apply a loaded payload onto a fresh LaserEVM. Returns
+    (start_tx_index, pending_work_list)."""
+    laser.open_states = payload["open_states"]
+    laser.executed_nodes = payload["executed_nodes"]
+    laser.total_states = payload["total_states"]
+    _restore_detector_state(payload["detectors"])
+    log.info("resumed host checkpoint: tx %d, %d open states, %d pending "
+             "worklist states", payload["tx_index"],
+             len(payload["open_states"]), len(payload["work_list"]))
+    return payload["tx_index"], payload["work_list"]

@@ -68,6 +68,15 @@ model_cache = ModelCache()
 #: query-result cache keyed by the constraint tuple (terms are hash-consed)
 _result_cache = LRUCache(2 ** 16)
 
+
+def reset_model_caches() -> None:
+    """Drop the sat-model reuse cache and the query-result cache (used by
+    solver.reset_solver_backend; results cached against a now-discarded
+    pipeline's models must not leak into a fresh one)."""
+    global model_cache, _result_cache
+    model_cache = ModelCache()
+    _result_cache = LRUCache(2 ** 16)
+
 #: zero model tried first: most path constraints are satisfied by all-zeros
 _ZERO_MODEL = Model()
 

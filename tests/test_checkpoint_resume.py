@@ -1,0 +1,85 @@
+"""Host-phase checkpoint/resume (VERDICT r3 missing #4 / next-round #6).
+
+The reference has no engine checkpointing at all (SURVEY §5); round 3 added
+device-phase .npz snapshots only, so a killed `--bin-runtime` analysis (pure
+host) lost everything. These tests cut an analysis mid-way at a transaction
+boundary — exactly what a kill between transactions leaves on disk — and
+assert the resumed run emits the identical issue set.
+"""
+
+import os
+import pickle
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from mythril_tpu.analysis.security import fire_lasers, reset_callback_modules
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.frontends.asm import assemble, creation_wrapper, dispatcher
+from mythril_tpu.smt.solver import sat
+
+pytestmark = pytest.mark.skipif(not sat.have_native(),
+                                reason="native CDCL build required")
+
+
+def _analyze(tx_count, modules, checkpoint=None, resume=None):
+    from test_analysis import KILLBILLY
+
+    reset_callback_modules()
+    creation = creation_wrapper(assemble(dispatcher(KILLBILLY)))
+    wrapper = SymExecWrapper(
+        creation.hex(), address=None, strategy="bfs", max_depth=128,
+        execution_timeout=240, create_timeout=30,
+        transaction_count=tx_count, modules=modules,
+        compulsory_statespace=False, checkpoint_path=checkpoint,
+        resume_path=resume)
+    return fire_lasers(wrapper, white_list=modules)
+
+
+def test_resume_from_tx_boundary_finds_identical_issues(tmp_path):
+    """Cut after tx1 (the state a kill between transactions leaves), resume
+    into tx2: the 2-tx selfdestruct chain must still be found, identical to
+    the uninterrupted run."""
+    modules = ["AccidentallyKillable"]
+    full = _analyze(2, modules)
+    assert sorted(i.swc_id for i in full) == ["106"]
+
+    ckpt = str(tmp_path / "analysis.ckpt")
+    partial = _analyze(1, modules, checkpoint=ckpt)
+    assert partial == []  # 1 tx cannot reach the selfdestruct
+    assert os.path.exists(ckpt)
+
+    resumed = _analyze(2, modules, resume=ckpt)
+    assert sorted(i.swc_id for i in resumed) == \
+        sorted(i.swc_id for i in full) == ["106"]
+    # witness parity, not just SWC-id parity
+    assert resumed[0].transaction_sequence["steps"][-1]["input"] == \
+        full[0].transaction_sequence["steps"][-1]["input"]
+
+
+def test_checkpoint_payload_roundtrip(tmp_path):
+    """The pickle payload must restore worklist/open-state structure exactly
+    (terms re-intern: identity-equality survives the round-trip)."""
+    from mythril_tpu.support import checkpoint as cp
+
+    modules = ["AccidentallyKillable"]
+    ckpt = str(tmp_path / "payload.ckpt")
+    _analyze(1, modules, checkpoint=ckpt)
+    payload = cp.load_host_checkpoint(ckpt)
+    assert payload is not None
+    assert payload["tx_index"] == 1
+    assert payload["open_states"], "no open states captured"
+    state = payload["open_states"][0]
+    for constraint in state.constraints:
+        reloaded = pickle.loads(pickle.dumps(constraint.raw))
+        assert reloaded is constraint.raw  # hash-consing identity preserved
+
+
+def test_corrupt_checkpoint_degrades_to_fresh_run(tmp_path):
+    ckpt = tmp_path / "garbage.ckpt"
+    ckpt.write_bytes(b"not a pickle")
+    modules = ["AccidentallyKillable"]
+    issues = _analyze(2, modules, resume=str(ckpt))
+    assert sorted(i.swc_id for i in issues) == ["106"]

@@ -171,9 +171,12 @@ def test_wall_clock_timeout_enforced():
     measured ~20% past budget on conflicts alone)."""
     import time
 
-    pipeline = _get_pipeline()
-    if pipeline is None:
-        pytest.skip("pipeline unavailable")
+    from mythril_tpu.smt.solver.incremental import IncrementalPipeline
+
+    # fresh pipeline: the wall-clock bound is on the SOLVE loop; a pool
+    # polluted by earlier tests adds unbounded blasting/propagation overhead
+    # outside the deadline and makes the elapsed assertion meaningless
+    pipeline = IncrementalPipeline()
     x = sym("tmo_x", 64)
     y = sym("tmo_y", 64)
     # factoring a 64-bit semiprime: far beyond any sane conflict budget
