@@ -117,6 +117,15 @@ class LaneSpec:
         self.selfbalance = selfbalance
 
 
+def next_pow2(value: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(value, floor) — the package's one shape
+    bucketing helper (stable XLA signatures over exact-fit capacities)."""
+    capacity = floor
+    while capacity < value:
+        capacity *= 2
+    return capacity
+
+
 def _jumpdest_bitmap(code: bytes, capacity: int) -> np.ndarray:
     """Valid JUMPDEST byte offsets (0x5b outside PUSH immediates)."""
     bitmap = np.zeros(capacity, dtype=bool)
@@ -138,10 +147,17 @@ def _word_rows(values) -> np.ndarray:
 def build_batch(specs, stack_slots: int = 96, memory_bytes: int = 4096,
                 calldata_bytes: int = 512, retdata_bytes: int = 512,
                 storage_slots: int = 64, tstore_slots: int = 8) -> StateBatch:
-    """Pack host LaneSpecs into one dense StateBatch."""
+    """Pack host LaneSpecs into one dense StateBatch.
+
+    code/calldata capacities are BUCKETED to powers of two (min 256):
+    exact-fit capacities gave every contract its own XLA shape signature,
+    so a corpus sweep recompiled the fused symbolic step per contract
+    (SURVEY §7 hard part #4 — padding tiers over bucketed recompilation)."""
     n = len(specs)
-    code_cap = max(1, max(len(s.code) for s in specs))
-    calldata_cap = max(calldata_bytes, max(len(s.calldata) for s in specs))
+    code_cap = next_pow2(max(1, max(len(s.code) for s in specs)), floor=256)
+    calldata_cap = next_pow2(max(calldata_bytes,
+                                 max(len(s.calldata) for s in specs)),
+                             floor=256)
 
     code = np.zeros((n, code_cap), dtype=np.uint8)
     jumpdest = np.zeros((n, code_cap), dtype=bool)

@@ -104,6 +104,7 @@ class _Frontier:
         self.forks = 0
         self.infeasible = 0
         self.faults = 0  # cold-SLOAD fault-ins serviced
+        self._lane_sharding_cache = Ellipsis  # unset sentinel
         #: instruction-states executed on device (live lanes x steps) — the
         #: symbolic analogue of the host engine's executed_nodes counter
         self.lane_steps = 0
@@ -264,16 +265,15 @@ class _Frontier:
         # (~50s on the remote-TPU path — measured eating the entire bench
         # budget mid-run)
         state, planes = self._to_device(state, planes)
-        iteration = 0
+        # one fused chunk can allocate ~3 nodes/lane/step; the headroom
+        # margin must cover a full chunk burst or symstep's overflow guard
+        # silently kills lanes (paths dropped from the report)
+        headroom = max(ARENA_HEADROOM, 4 * chunk * self.n_lanes)
         while steps < max_steps:
-            # the headroom pull is a device->host scalar sync; CHUNK-sized
-            # allocation bursts cannot overrun ARENA_HEADROOM in 8 chunks
-            if iteration % 8 == 0 and \
-                    int(self.arena.n) > self.arena.capacity - ARENA_HEADROOM:
+            if int(self.arena.n) > self.arena.capacity - headroom:
                 log.warning("arena head-room exhausted; handing remaining "
                             "lanes to the host")
                 break
-            iteration += 1
             if time_handler.time_remaining() <= 1000:  # ms
                 log.info("execution budget exhausted; ending device phase")
                 break
@@ -289,8 +289,11 @@ class _Frontier:
             self.lane_steps += int(np.sum(live_before & still_live)) * chunk \
                 + int(np.sum(live_before & ~still_live))
             # device forks = DEAD lanes claimed as fork targets (a revived
-            # frozen forker is the SAME path continuing, not a new fork)
-            self.forks += int(np.sum((status_before == DEAD) & still_live))
+            # frozen forker is the SAME path continuing, not a new fork);
+            # a claimed target may already have ESCAPED/paused again within
+            # the same chunk, so count any transition out of DEAD
+            self.forks += int(np.sum((status_before == DEAD)
+                                     & (status != DEAD)))
             if (status == FORKING).any() or (status == ESCAPED).any() \
                     or not (status == RUNNING).any():
                 state, planes = self._service(state, planes)
@@ -304,29 +307,83 @@ class _Frontier:
         # budget exhausted: surviving lanes continue on host
         self._hand_over_running(state, planes)
 
-    @staticmethod
-    def _to_device(state: StateBatch, planes: symstep.SymPlanes):
+    def _lane_sharding(self):
+        if self._lane_sharding_cache is not Ellipsis:
+            return self._lane_sharding_cache
+        self._lane_sharding_cache = self._compute_lane_sharding()
+        return self._lane_sharding_cache
+
+    def _compute_lane_sharding(self):
+        """NamedSharding over the lane axis when the process has multiple
+        devices (SURVEY §2.3 'sharded frontier over devices ≡ multi-chip
+        DP'). Fork-target allocation runs a cumsum over the GLOBAL lane
+        axis, so a forker on one device claims dead capacity on any other —
+        XLA's inserted collectives ARE the load-aware rebalance.
+
+        Gating: MYTHRIL_TPU_SHARD=1 forces on, =0 forces off; default is
+        on only for REAL accelerator meshes (the CI conftest creates 8
+        virtual CPU devices for mesh tests, and paying the GSPMD compile
+        of the fused step on every CPU test run is not acceptable)."""
+        import os
+
+        import jax
+
+        devices = jax.devices()
+        flag = os.environ.get("MYTHRIL_TPU_SHARD")
+        if flag == "1" and len(devices) > 1 and self.n_lanes % len(devices):
+            log.warning(
+                "MYTHRIL_TPU_SHARD=1 but %d lanes do not divide across %d "
+                "devices; running single-device (set MYTHRIL_TPU_LANES to a "
+                "multiple of the device count)", self.n_lanes, len(devices))
+        if flag == "0" or len(devices) < 2 or self.n_lanes % len(devices):
+            return None
+        if flag != "1" and devices[0].platform == "cpu":
+            return None
+        from jax.sharding import (Mesh, NamedSharding, PartitionSpec)
+
+        mesh = Mesh(np.array(devices), ("lanes",))
+        return NamedSharding(mesh, PartitionSpec("lanes"))
+
+    def _to_device(self, state: StateBatch, planes: symstep.SymPlanes):
         import jax
 
         # ONE batched async transfer for the whole pytree: 40+ sequential
         # per-field puts each paid a full round-trip on the remote-TPU
         # tunnel (~12s of dead time per seeding at 512 lanes)
-        return jax.device_put((state, planes))
+        sharding = self._lane_sharding()
+        if sharding is None:
+            return jax.device_put((state, planes))
+        return jax.device_put((state, planes), jax.tree_util.tree_map(
+            lambda _: sharding, (state, planes)))
 
     def _materialize_lanes(self, state: StateBatch, planes, harena,
                            lanes) -> None:
         """Batched materialization: gather the selected lanes' rows on
-        device, fetch them in one transfer, and materialize each row."""
+        device, fetch them in one transfer, and materialize each row.
+
+        The index is padded to a power-of-two bucket: every distinct gather
+        shape costs an XLA compile of ~40 kernels, and un-padded per-service
+        escape counts (1, 3, 5, ...) made compiles 90% of a profiled
+        analysis. Bucketing bounds that to ~log2(n_lanes) compiles."""
         import jax
 
+        from .batch import next_pow2
+
         index = np.asarray(lanes)
+        count = len(index)
+        bucket = next_pow2(count)
+        padded = np.zeros(bucket, dtype=np.int64)
+        padded[:count] = index  # tail repeats lane index[0]: fetched, unused
+        if count:
+            padded[count:] = index[0]
         rows_state, rows_planes = jax.device_get(
-            jax.tree_util.tree_map(lambda leaf: leaf[index], (state, planes)))
+            jax.tree_util.tree_map(lambda leaf: leaf[padded],
+                                   (state, planes)))
         state_rows = {field: np.asarray(getattr(rows_state, field))
                       for field in rows_state._fields}
         planes_rows = {field: np.asarray(getattr(rows_planes, field))
                        for field in rows_planes._fields}
-        for row in range(len(index)):
+        for row in range(count):
             self._materialize_np(state_rows, planes_rows, harena, row)
 
     def _service(self, state: StateBatch, planes: symstep.SymPlanes):
@@ -586,6 +643,15 @@ class _Frontier:
             [self.forks, self.infeasible, self.materialized, self.lane_steps])
         arrays["identity"] = np.asarray(
             [self.n_lanes, len(self.contexts)])
+        # V_HOST_TERM leaves index into per-context host_terms lists that
+        # GROW after seeding (cold-SLOAD fault-ins); a resume that rebuilt
+        # only the seed-time lists would resolve checkpointed nodes against
+        # wrong terms. Terms pickle exactly (smt/terms.py Term.__reduce__).
+        import pickle
+
+        arrays["host_terms"] = np.frombuffer(
+            pickle.dumps([ctx.host_terms for ctx in self.contexts]),
+            dtype=np.uint8)
         import os
 
         tmp = f"{path}.tmp"
@@ -606,6 +672,16 @@ class _Frontier:
                 f"checkpoint identity mismatch: saved {n_lanes} lanes / "
                 f"{n_contexts} contexts, this frontier has {self.n_lanes} / "
                 f"{len(self.contexts)}")
+        if "host_terms" in data:
+            import pickle
+
+            for ctx, saved_terms in zip(
+                    self.contexts,
+                    pickle.loads(data["host_terms"].tobytes())):
+                ctx.host_terms = saved_terms
+        else:
+            raise ValueError("checkpoint predates host_terms serialization; "
+                             "V_HOST_TERM leaves would resolve wrongly")
         state = StateBatch(**{f: data[f"state_{f}"]
                               for f in StateBatch._fields})
         planes = symstep.SymPlanes(**{f: data[f"planes_{f}"]

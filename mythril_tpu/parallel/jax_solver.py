@@ -66,7 +66,9 @@ class _Problem(NamedTuple):
 
 
 def _next_pow2(value: int) -> int:
-    return 1 << max(0, (value - 1)).bit_length()
+    from .batch import next_pow2
+
+    return next_pow2(value)
 
 
 def _build_problem(clauses: List[List[int]], n_vars: int,

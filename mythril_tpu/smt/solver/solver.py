@@ -100,6 +100,14 @@ def reset_solver_backend() -> None:
     from ...support import model as model_service
 
     model_service.reset_model_caches()
+    # a PREVIOUS analysis's expired global clock must not clamp fresh
+    # queries to a ~0ms solver budget (get_model enforces
+    # time_handler.time_remaining; the singleton outlives the analysis
+    # that started it, so standalone is_possible() calls after an analysis
+    # silently reported sat queries as impossible)
+    from ...core.time_handler import TimeHandler
+
+    TimeHandler()._start_time = None
 
 
 def check_formulas(raw_constraints: List[terms.Term],

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""BASELINE.md corpus measurement (VERDICT r3 next-round #9).
+
+Runs the reference's deployed-bytecode corpus
+(tests/testdata/inputs/*.sol.o, read from /root/reference) through
+`analyze --bin-runtime` under both engines, recording per-contract:
+states explored, wall time, states/sec, time-to-first-finding, and the
+SWC issue set. Emits corpus_results.json at the repo root; bench.py
+attaches it to the driver metric line as `corpus` extras.
+
+The reference itself (CPU/z3) is not runnable in this environment (no
+z3-solver); per BASELINE.md the host engine — the same worklist design the
+reference implements — is the measured stand-in baseline.
+
+Usage: python tools/measure_corpus.py [--engine host|tpu] [--budget 90]
+       [--contracts a,b,c]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+INPUTS = "/root/reference/tests/testdata/inputs"
+
+DEFAULT_CONTRACTS = [
+    "origin.sol.o", "suicide.sol.o", "ether_send.sol.o", "exceptions.sol.o",
+    "returnvalue.sol.o", "overflow.sol.o", "underflow.sol.o", "calls.sol.o",
+    "metacoin.sol.o",
+]
+
+
+def measure(engine: str, budget: int, contracts):
+    from mythril_tpu.analysis.security import (fire_lasers,
+                                               reset_callback_modules)
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.smt.solver.solver import reset_solver_backend
+
+    if engine == "tpu":
+        # compile warm-up on a trivial contract so the first measured
+        # contract's budget is exploration, not XLA compile (shapes are
+        # bucketed — parallel/batch.py — so the compile carries over)
+        import types
+
+        reset_callback_modules()
+        os.environ["MYTHRIL_TPU_SKIP_HOST_DRAIN"] = "1"
+        try:
+            SymExecWrapper(
+                types.SimpleNamespace(code="0x6001600101600055", name="warm"),
+                address=0xD00D, strategy="bfs", max_depth=32,
+                execution_timeout=150, create_timeout=30,
+                transaction_count=1, compulsory_statespace=False,
+                run_analysis_modules=False, engine="tpu")
+        finally:
+            del os.environ["MYTHRIL_TPU_SKIP_HOST_DRAIN"]
+
+    results = {}
+    for name in contracts:
+        path = os.path.join(INPUTS, name)
+        code = open(path).read().strip()
+        reset_callback_modules()
+        reset_solver_backend()
+        first_finding = {}
+
+        from mythril_tpu.analysis.module.base import DetectionModule
+
+        original = DetectionModule._cache_issues \
+            if hasattr(DetectionModule, "_cache_issues") else None
+
+        start = time.perf_counter()
+        import types
+
+        contract = types.SimpleNamespace(code=code, name=name)
+        try:
+            wrapper = SymExecWrapper(
+                contract, address=0xDEADBEEF, strategy="bfs", max_depth=128,
+                execution_timeout=budget, create_timeout=30,
+                transaction_count=2, compulsory_statespace=False,
+                engine=engine)
+            issues = fire_lasers(wrapper)
+        except Exception as error:  # noqa: BLE001 — record and continue
+            results[name] = {"error": f"{type(error).__name__}: {error}"}
+            continue
+        elapsed = time.perf_counter() - start
+        laser = wrapper.laser
+        states = laser.executed_nodes + getattr(laser,
+                                                "frontier_lane_steps", 0)
+        results[name] = {
+            "states": states,
+            "elapsed_s": round(elapsed, 2),
+            "states_per_sec": round(states / max(elapsed, 1e-9), 1),
+            "swc": sorted({i.swc_id for i in issues}),
+            "n_issues": len(issues),
+            "forks_on_device": getattr(laser, "frontier_forks", 0),
+        }
+        print(json.dumps({"contract": name, "engine": engine,
+                          **results[name]}), flush=True)
+    return results
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--engine", default="host", choices=["host", "tpu"])
+    parser.add_argument("--budget", type=int, default=90)
+    parser.add_argument("--contracts", default=None)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+    contracts = (args.contracts.split(",") if args.contracts
+                 else DEFAULT_CONTRACTS)
+    results = measure(args.engine, args.budget, contracts)
+    rates = [r["states_per_sec"] for r in results.values()
+             if "states_per_sec" in r]
+    summary = {
+        "engine": args.engine,
+        "budget_s": args.budget,
+        "contracts": results,
+        "median_states_per_sec": sorted(rates)[len(rates) // 2]
+        if rates else None,
+        "total_swc_findings": sum(r.get("n_issues", 0)
+                                  for r in results.values()),
+    }
+    out_path = args.out or os.path.join(
+        REPO, f"corpus_{args.engine}.json")
+    with open(out_path, "w") as handle:
+        json.dump(summary, handle, indent=1)
+    print(json.dumps({"summary": {k: v for k, v in summary.items()
+                                  if k != "contracts"}}))
+
+
+if __name__ == "__main__":
+    main()
