@@ -20,6 +20,13 @@ class TimeHandler:
         self._start_time = int(time.time() * 1000)
         self._execution_time = execution_time_seconds * 1000
 
+    def reset(self) -> None:
+        """Disarm the budget (back to the never-started state). A finished
+        analysis's expired clock must not clamp later standalone solver
+        queries to a ~0ms budget."""
+        self._start_time = None
+        self._execution_time = None
+
     def time_remaining(self) -> int:
         """Milliseconds left in the global budget (large if never started)."""
         if self._start_time is None:

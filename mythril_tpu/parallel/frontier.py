@@ -59,6 +59,27 @@ DEFAULT_LANES = 128
 MAX_CONDS = 64
 
 
+def _gather_rows(state, planes, index):
+    """jit-bundled row gather: one XLA program per (bucket, shape
+    signature) instead of ~44 individually-dispatched (and individually
+    COMPILED) per-leaf gathers — those dominated profiled analyses."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda leaf: leaf[index], (state, planes))
+
+
+_gather_rows_jit = None
+
+
+def _gather_rows_compiled():
+    global _gather_rows_jit
+    if _gather_rows_jit is None:
+        import jax
+
+        _gather_rows_jit = jax.jit(_gather_rows)
+    return _gather_rows_jit
+
+
 class LaneContext(A.TxContext):
     """Seeding context: one (open world state, transaction) pair."""
 
@@ -268,7 +289,8 @@ class _Frontier:
         # one fused chunk can allocate ~3 nodes/lane/step; the headroom
         # margin must cover a full chunk burst or symstep's overflow guard
         # silently kills lanes (paths dropped from the report)
-        headroom = max(ARENA_HEADROOM, 4 * chunk * self.n_lanes)
+        headroom = min(max(ARENA_HEADROOM, 4 * chunk * self.n_lanes),
+                       self.arena.capacity // 2)
         while steps < max_steps:
             if int(self.arena.n) > self.arena.capacity - headroom:
                 log.warning("arena head-room exhausted; handing remaining "
@@ -377,8 +399,8 @@ class _Frontier:
         if count:
             padded[count:] = index[0]
         rows_state, rows_planes = jax.device_get(
-            jax.tree_util.tree_map(lambda leaf: leaf[padded],
-                                   (state, planes)))
+            _gather_rows_compiled()(state, planes,
+                                    padded.astype(np.int32)))
         state_rows = {field: np.asarray(getattr(rows_state, field))
                       for field in rows_state._fields}
         planes_rows = {field: np.asarray(getattr(rows_planes, field))

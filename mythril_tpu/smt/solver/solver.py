@@ -105,9 +105,9 @@ def reset_solver_backend() -> None:
     # time_handler.time_remaining; the singleton outlives the analysis
     # that started it, so standalone is_possible() calls after an analysis
     # silently reported sat queries as impossible)
-    from ...core.time_handler import TimeHandler
+    from ...core.time_handler import time_handler
 
-    TimeHandler()._start_time = None
+    time_handler.reset()
 
 
 def check_formulas(raw_constraints: List[terms.Term],

@@ -4,9 +4,9 @@
 Runs the reference's deployed-bytecode corpus
 (tests/testdata/inputs/*.sol.o, read from /root/reference) through
 `analyze --bin-runtime` under both engines, recording per-contract:
-states explored, wall time, states/sec, time-to-first-finding, and the
-SWC issue set. Emits corpus_results.json at the repo root; bench.py
-attaches it to the driver metric line as `corpus` extras.
+states explored, wall time, states/sec, and the SWC issue set. Emits
+corpus_{engine}.json at the repo root; bench.py attaches the summaries to
+the driver metric line as `corpus` extras.
 
 The reference itself (CPU/z3) is not runnable in this environment (no
 z3-solver); per BASELINE.md the host engine — the same worklist design the
@@ -59,22 +59,15 @@ def measure(engine: str, budget: int, contracts):
 
     results = {}
     for name in contracts:
-        path = os.path.join(INPUTS, name)
-        code = open(path).read().strip()
         reset_callback_modules()
         reset_solver_backend()
-        first_finding = {}
-
-        from mythril_tpu.analysis.module.base import DetectionModule
-
-        original = DetectionModule._cache_issues \
-            if hasattr(DetectionModule, "_cache_issues") else None
-
         start = time.perf_counter()
         import types
 
-        contract = types.SimpleNamespace(code=code, name=name)
         try:
+            with open(os.path.join(INPUTS, name)) as handle:
+                code = handle.read().strip()
+            contract = types.SimpleNamespace(code=code, name=name)
             wrapper = SymExecWrapper(
                 contract, address=0xDEADBEEF, strategy="bfs", max_depth=128,
                 execution_timeout=budget, create_timeout=30,
