@@ -87,7 +87,7 @@ for _cls in PREDICTABLE_CLASSES | {V_ORIGIN}:
     PREDICTABLE_MASK |= 1 << _cls
 
 
-def new_arena(capacity: int = 1 << 18, const_capacity: int = 1 << 14) -> Arena:
+def new_arena(capacity: int = 1 << 19, const_capacity: int = 1 << 15) -> Arena:
     return Arena(
         op=jnp.zeros(capacity, dtype=I32),
         a=jnp.zeros(capacity, dtype=I32),

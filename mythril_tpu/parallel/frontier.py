@@ -288,9 +288,18 @@ class _Frontier:
         state, planes = self._to_device(state, planes)
         # one fused chunk can allocate ~3 nodes/lane/step; the headroom
         # margin must cover a full chunk burst or symstep's overflow guard
-        # silently kills lanes (paths dropped from the report)
-        headroom = min(max(ARENA_HEADROOM, 4 * chunk * self.n_lanes),
-                       self.arena.capacity // 2)
+        # silently kills lanes (paths dropped from the report). A config
+        # whose burst cannot fit gets a LOUD host hand-over, not a margin
+        # too small to be safe
+        headroom = max(ARENA_HEADROOM, 4 * chunk * self.n_lanes)
+        if headroom > self.arena.capacity // 2:
+            log.warning(
+                "MYTHRIL_TPU_CHUNK (%d) x lanes (%d) allocation burst "
+                "exceeds the arena safety margin (capacity %d); running "
+                "this transaction on the host — lower the chunk or lane "
+                "count", chunk, self.n_lanes, self.arena.capacity)
+            self._hand_over_running(state, planes)
+            return
         while steps < max_steps:
             if int(self.arena.n) > self.arena.capacity - headroom:
                 log.warning("arena head-room exhausted; handing remaining "
