@@ -32,6 +32,10 @@ _cache_dir = os.environ.get(
 try:
     os.makedirs(_cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    # cache EVERY executable: the frontier's service helpers (row gather/
+    # scatter, arena-delta fetch) compile per power-of-two bucket shape, and
+    # each sub-2s compile re-paid on every process added up to ~20s/run on
+    # the remote-TPU path
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 except Exception:  # cache is an optimization, never a hard requirement
     pass

@@ -87,7 +87,7 @@ for _cls in PREDICTABLE_CLASSES | {V_ORIGIN}:
     PREDICTABLE_MASK |= 1 << _cls
 
 
-def new_arena(capacity: int = 1 << 19, const_capacity: int = 1 << 15) -> Arena:
+def new_arena(capacity: int = 1 << 21, const_capacity: int = 1 << 17) -> Arena:
     return Arena(
         op=jnp.zeros(capacity, dtype=I32),
         a=jnp.zeros(capacity, dtype=I32),
@@ -177,25 +177,93 @@ _CMP = {0x10: ("bvult", False), 0x11: ("bvult", True),   # LT, GT(swap)
         0x14: ("eq", False)}                             # EQ
 
 
+_ROW_COLS = ("op", "a", "b", "c", "imm", "imm2")
+
+_delta_jit = None
+
+
+def _fetch_delta(arena: Arena, start, cstart, bucket: int, cbucket: int):
+    """One jitted program per (bucket, cbucket) shape: dynamic_slice the new
+    arena rows + const rows into fixed-size blocks, fetched in ONE transfer.
+    Per-(start, length) basic slicing would compile a fresh XLA program for
+    every service round on the remote-TPU tunnel."""
+    from jax import lax
+
+    rows = jnp.stack([lax.dynamic_slice(getattr(arena, col), (start,),
+                                        (bucket,)) for col in _ROW_COLS])
+    consts = lax.dynamic_slice(arena.const_vals, (cstart, jnp.int32(0)),
+                               (cbucket, arena.const_vals.shape[1]))
+    return rows, consts
+
+
+def _fetch_delta_jit():
+    global _delta_jit
+    if _delta_jit is None:
+        import jax
+
+        _delta_jit = jax.jit(_fetch_delta,
+                             static_argnames=("bucket", "cbucket"))
+    return _delta_jit
+
+
 class HostArena:
-    """Host snapshot of the arena tables + memoized term conversion."""
+    """Incrementally-mirrored host copy of the arena tables + memoized term
+    conversion. The arena is append-only, so rows never change once fetched:
+    `refresh` transfers ONLY the rows allocated since the last call (bucketed
+    dynamic_slice, one jit signature per power-of-two delta), and the term
+    memo survives across service rounds — shared condition prefixes convert
+    to host terms exactly once per analysis, not once per service."""
 
     def __init__(self, arena: Arena):
-        # transfer only the used prefix: the arena tables are allocated at
-        # full capacity (1<<18 rows) on device, and a snapshot per service
-        # round-trip would move ~7MB through the host<->TPU tunnel each time
-        used = int(arena.n)
-        used_const = int(arena.n_const)
-        self.op = np.asarray(arena.op[:used])
-        self.a = np.asarray(arena.a[:used])
-        self.b = np.asarray(arena.b[:used])
-        self.c = np.asarray(arena.c[:used])
-        self.imm = np.asarray(arena.imm[:used])
-        self.imm2 = np.asarray(arena.imm2[:used])
-        self.n = used
-        self.const_vals = np.asarray(arena.const_vals[:used_const])
+        capacity = arena.capacity
+        self.op = np.zeros(capacity, dtype=np.int32)
+        self.a = np.zeros(capacity, dtype=np.int32)
+        self.b = np.zeros(capacity, dtype=np.int32)
+        self.c = np.zeros(capacity, dtype=np.int32)
+        self.imm = np.zeros(capacity, dtype=np.int32)
+        self.imm2 = np.zeros(capacity, dtype=np.int32)
+        self.const_vals = np.zeros((arena.const_vals.shape[0],
+                                    arena.const_vals.shape[1]),
+                                   dtype=np.uint32)
+        self.n = 0
+        self.n_const = 0
         self._memo: Dict[int, object] = {}
         self._var_memo: Dict[int, set] = {}
+        self.refresh(arena)
+
+    def refresh(self, arena: Arena) -> None:
+        """Mirror rows [self.n, arena.n) and consts [self.n_const, n_const)."""
+        from .batch import next_pow2
+
+        used = int(arena.n)
+        used_const = int(arena.n_const)
+        delta = used - self.n
+        cdelta = used_const - self.n_const
+        if delta <= 0 and cdelta <= 0:
+            return
+        bucket = min(max(next_pow2(max(delta, 1)), 16), self.op.shape[0])
+        cbucket = min(max(next_pow2(max(cdelta, 1)), 16),
+                      self.const_vals.shape[0])
+        # clamp so start+bucket fits (dynamic_slice clamps the START, which
+        # would silently misalign rows); compensate with a host-side offset
+        start = min(self.n, self.op.shape[0] - bucket)
+        cstart = min(self.n_const, self.const_vals.shape[0] - cbucket)
+        rows, consts = _fetch_delta_jit()(
+            arena, np.int32(max(start, 0)), np.int32(max(cstart, 0)),
+            bucket=bucket, cbucket=cbucket)
+        rows = np.asarray(rows)
+        consts = np.asarray(consts)
+        if delta > 0:
+            off = self.n - max(start, 0)
+            for position, col in enumerate(_ROW_COLS):
+                getattr(self, col)[self.n:used] = \
+                    rows[position, off:off + delta]
+            self.n = used
+        if cdelta > 0:
+            coff = self.n_const - max(cstart, 0)
+            self.const_vals[self.n_const:used_const] = \
+                consts[coff:coff + cdelta]
+            self.n_const = used_const
 
     def to_term(self, node_id: int, ctx: "TxContext"):
         """Arena node -> smt BitVec (host term), via ctx's variable leaves."""

@@ -509,3 +509,23 @@ def sym_step_many(state: StateBatch, planes: SymPlanes, arena: A.Arena,
         return sym_step(*carry)
 
     return jax.lax.fori_loop(0, n_steps, body, (state, planes, arena))
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def sym_step_many_counted(state: StateBatch, planes: SymPlanes,
+                          arena: A.Arena, n_steps: int):
+    """sym_step_many plus an exact executed-instruction count, accumulated
+    ON DEVICE: lanes forked into mid-chunk and revived frozen forkers step
+    inside the fused loop where host-side before/after status diffs cannot
+    see them (the round-4 accounting credited a claimed fork target 0 steps
+    no matter how many it executed). One RUNNING lane stepping once == one
+    instruction-state, the same unit as the host engine's executed_nodes."""
+    def body(_, carry):
+        state, planes, arena, executed = carry
+        executed = executed + jnp.sum(
+            (state.status == RUNNING).astype(jnp.int64))
+        state, planes, arena = sym_step(state, planes, arena)
+        return state, planes, arena, executed
+
+    return jax.lax.fori_loop(
+        0, n_steps, body, (state, planes, arena, jnp.int64(0)))

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Microbenchmark: fused sym_step_many throughput vs (lanes, chunk) on the
+real chip, plus raw tunnel round-trip latency. Picks the frontier's default
+batch geometry."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mythril_tpu.parallel import arena as A
+    from mythril_tpu.parallel import batch as pbatch
+    from mythril_tpu.parallel import symstep
+
+    print("backend:", jax.devices()[0].platform)
+
+    # tunnel round-trip: dispatch + fetch of a trivial op
+    x = jax.device_put(np.zeros(8, dtype=np.int32))
+    f = jax.jit(lambda v: v + 1)
+    jax.block_until_ready(f(x))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        jax.block_until_ready(f(x))
+    print({"tunnel_rt_ms": round((time.perf_counter() - t0) / 10 * 1000, 1)})
+
+    # a loop body with a symbolic compare so planes stay exercised, but no
+    # JUMPI fork (lanes run forever): CALLDATALOAD x; PUSH1 1; ADD; POP ...
+    code = bytes.fromhex("5b" "600035" "6001" "01" "50" "600056")
+    for lanes in (512, 2048):
+        specs = [pbatch.LaneSpec(code, gas_limit=2 ** 60)
+                 for _ in range(lanes)]
+        state = pbatch.build_batch(specs)
+        planes = symstep.SymPlanes.empty(
+            lanes, state.stack.shape[1], state.memory.shape[1],
+            state.storage_keys.shape[1], 64)
+        arena = A.new_arena()
+        row_bytes = sum(np.asarray(leaf).nbytes
+                        for leaf in list(state) + list(planes)) // lanes
+        for chunk in (32,):
+            s, p, a = symstep.sym_step_many(state, planes, arena, chunk)
+            jax.block_until_ready(s.pc)  # compile
+            reps = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 3.0:
+                s, p, a = symstep.sym_step_many(s, p, a, chunk)
+                jax.block_until_ready(s.pc)
+                reps += 1
+            dt = time.perf_counter() - t0
+            rate = reps * chunk * lanes / dt
+            print({"lanes": lanes, "chunk": chunk,
+                   "lane_steps_per_sec": int(rate),
+                   "ms_per_chunk": round(dt / reps * 1000, 1),
+                   "row_bytes": int(row_bytes)})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Phase-level profile of the TPU frontier on the bench stress workload:
+how much of the wall clock goes to fused device steps vs host services vs
+transfers vs the host continuation. Run on the real chip:
+
+    python tools/profile_frontier.py [seconds] [lanes]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("MYTHRIL_TPU_LANES", "512")
+
+import numpy as np
+
+TIMES = {"step": 0.0, "service": 0.0, "to_device": 0.0,
+         "materialize": 0.0, "exec_host": 0.0}
+COUNTS = {"chunks": 0, "services": 0, "materialized_calls": 0}
+
+
+def patch():
+    import jax
+
+    from mythril_tpu.parallel import frontier, symstep
+
+    real_step = symstep.sym_step_many_counted
+    real_service = frontier._Frontier._service
+    real_to_device = frontier._Frontier._to_device
+    real_mat = frontier._Frontier._materialize_lanes
+
+    def timed_step(state, planes, arena, chunk):
+        t0 = time.perf_counter()
+        out = real_step(state, planes, arena, chunk)
+        jax.block_until_ready(out[0].status)
+        TIMES["step"] += time.perf_counter() - t0
+        COUNTS["chunks"] += 1
+        return out
+
+    def timed_service(self, state, planes):
+        t0 = time.perf_counter()
+        out = real_service(self, state, planes)
+        TIMES["service"] += time.perf_counter() - t0
+        COUNTS["services"] += 1
+        return out
+
+    def timed_to_device(self, state, planes):
+        t0 = time.perf_counter()
+        out = real_to_device(self, state, planes)
+        TIMES["to_device"] += time.perf_counter() - t0
+        return out
+
+    def timed_mat(self, state, planes, harena, lanes):
+        t0 = time.perf_counter()
+        out = real_mat(self, state, planes, harena, lanes)
+        TIMES["materialize"] += time.perf_counter() - t0
+        COUNTS["materialized_calls"] += len(lanes)
+        return out
+
+    symstep.sym_step_many_counted = timed_step
+    frontier.symstep.sym_step_many_counted = timed_step
+    frontier._Frontier._service = timed_service
+    frontier._Frontier._to_device = timed_to_device
+    frontier._Frontier._materialize_lanes = timed_mat
+
+
+def main():
+    seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
+    if len(sys.argv) > 2:
+        os.environ["MYTHRIL_TPU_LANES"] = sys.argv[2]
+
+    import logging
+
+    logging.basicConfig(level=logging.INFO)
+
+    import bench
+
+    # warm the compile outside the measured window
+    os.environ["MYTHRIL_TPU_MAX_STEPS"] = "16"
+    os.environ["MYTHRIL_TPU_SKIP_HOST_DRAIN"] = "1"
+    bench._run_engine("tpu", 120)
+    del os.environ["MYTHRIL_TPU_SKIP_HOST_DRAIN"]
+    os.environ["MYTHRIL_TPU_MAX_STEPS"] = "4096"
+
+    patch()
+    from mythril_tpu.core import svm
+
+    real_exec = svm.LaserEVM.exec
+
+    def timed_exec(self, *a, **k):
+        t0 = time.perf_counter()
+        out = real_exec(self, *a, **k)
+        TIMES["exec_host"] += time.perf_counter() - t0
+        return out
+
+    svm.LaserEVM.exec = timed_exec
+
+    t0 = time.perf_counter()
+    rate, info = bench._run_engine("tpu", seconds)
+    wall = time.perf_counter() - t0
+    print({"rate": round(rate, 1), **info})
+    print({"wall_s": round(wall, 2),
+           **{k: round(v, 2) for k, v in TIMES.items()}, **COUNTS})
+    accounted = sum(TIMES.values()) - TIMES["materialize"]  # nested in service
+    print({"unaccounted_s": round(wall - accounted, 2)})
+
+
+if __name__ == "__main__":
+    main()
