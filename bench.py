@@ -16,9 +16,15 @@ engines explore the SAME contract through the SAME analysis entry point
     (parallel/frontier.py) — lanes fork at symbolic JUMPIs on device, path
     constraints as arena node ids, escaped lanes finished on the host.
 
-"states" = instruction-states executed: the host's executed_nodes counter,
-and for the frontier, live-lanes x fused-steps (frontier.lane_steps) plus the
-host continuation's executed_nodes.
+"states" = instruction-states executed: one EVM opcode applied to one
+(symbolic) machine state. The host engine counts executed_nodes; the frontier
+counts RUNNING-lane steps ON DEVICE (sched.executed, exact — fork targets and
+reseeded lanes count from their first step) plus the host continuation's
+executed_nodes. The unit is identical across engines and both explore the
+SAME optimistic tree (neither solver-checks at a fork — feasibility is
+decided at issue time, matching the reference's jumpi_ semantics), so
+states/sec is directly comparable. Neither engine gets credit for dropped
+work: rows the budget never reaches are discarded on both sides alike.
 
 Reporting protocol (BENCH_r03 lesson — the round-3 run timed out and its
 single end-of-run print lost every measurement):
@@ -34,9 +40,9 @@ import os
 import sys
 import time
 
-os.environ.setdefault("MYTHRIL_TPU_LANES", "512")
+os.environ.setdefault("MYTHRIL_TPU_LANES", "4096")
 
-N_BRANCHES = 16
+N_BRANCHES = int(os.environ.get("MYTHRIL_BENCH_BRANCHES", "20"))
 
 
 def _phase(name, **payload):
@@ -125,15 +131,18 @@ def main():
     # and the measured run pays it instead; MAX_STEPS bounds the device work
     # and SKIP_HOST_DRAIN prevents a full host continuation from burning the
     # rest of the warm-up window
-    os.environ["MYTHRIL_TPU_MAX_STEPS"] = "16"
+    # MAX_STEPS=4096 lets the warm-up reach escape drains so the pack /
+    # summary / scheduler programs all compile (or cache-load) OUTSIDE the
+    # measured window
+    os.environ["MYTHRIL_TPU_MAX_STEPS"] = "4096"
     os.environ["MYTHRIL_TPU_SKIP_HOST_DRAIN"] = "1"
     warm_start = time.perf_counter()
-    _run_engine("tpu", 120)
+    _run_engine("tpu", 150)
     del os.environ["MYTHRIL_TPU_SKIP_HOST_DRAIN"]
     _phase("tpu_warmup", compile_s=round(time.perf_counter() - warm_start, 1))
 
     # 3. the measured TPU run on warm caches
-    os.environ["MYTHRIL_TPU_MAX_STEPS"] = "4096"
+    os.environ["MYTHRIL_TPU_MAX_STEPS"] = "65536"
     tpu_rate, tpu_info = _run_engine("tpu", seconds)
     _phase("tpu", states_per_sec=round(tpu_rate, 1), **tpu_info)
 

@@ -36,6 +36,10 @@ from ..ops.opcodes import OPCODES, STACK
 
 log = logging.getLogger(__name__)
 
+#: _exec_pass sentinel: the budget ended (distinct from "worklist ran dry",
+#: which lets exec() refill from the frontier feeder)
+_EXEC_TIMED_OUT = object()
+
 
 class SVMError(Exception):
     pass
@@ -292,11 +296,28 @@ class LaserEVM:
 
     # -- main loop --------------------------------------------------------------------
     def exec(self, create: bool = False, track_gas: bool = False) -> Optional[List[GlobalState]]:
+        final_states: List[GlobalState] = []
+        while True:
+            result = self._exec_pass(create, track_gas, final_states)
+            if result is not None:
+                return None if result is _EXEC_TIMED_OUT else result
+            # refill from the TPU frontier's deferred-row feeder: drained
+            # escape rows materialize LAZILY, on demand, within this exec
+            # budget — rows never reached are dropped exactly like the
+            # host's own mid-worklist states at timeout
+            feeder = getattr(self, "frontier_feeder", None)
+            if feeder is None or not feeder():
+                break
+        return final_states if track_gas else None
+
+    def _exec_pass(self, create: bool, track_gas: bool,
+                   final_states: List[GlobalState]):
+        """One drain of the current worklist; returns a non-None result to
+        END exec (timeout), or None when the worklist ran dry."""
         import time as time_module
 
         from ..support.checkpoint import SAVE_INTERVAL_S
 
-        final_states: List[GlobalState] = []
         for global_state in self.strategy:
             if self.checkpoint_path and not create and \
                     time_module.monotonic() - self._last_checkpoint_time \
@@ -308,11 +329,13 @@ class LaserEVM:
             if create and self.create_timeout and \
                     self.time + timedelta(seconds=self.create_timeout) <= datetime.now():
                 log.debug("hit create timeout, returning")
-                return final_states + self.work_list if track_gas else None
+                return final_states + self.work_list if track_gas \
+                    else _EXEC_TIMED_OUT
             if not create and self.execution_timeout and \
                     self.time + timedelta(seconds=self.execution_timeout) <= datetime.now():
                 log.debug("hit execution timeout, returning")
-                return final_states + self.work_list if track_gas else None
+                return final_states + self.work_list if track_gas \
+                    else _EXEC_TIMED_OUT
 
             try:
                 new_states, op_code = self.execute_state(global_state)
@@ -335,7 +358,7 @@ class LaserEVM:
             if not new_states and track_gas:
                 final_states.append(global_state)
             self.total_states += len(new_states)
-        return final_states if track_gas else None
+        return None  # worklist dry: exec() may refill from the feeder
 
     def execute_state(self, global_state: GlobalState
                       ) -> Tuple[List[GlobalState], Optional[str]]:

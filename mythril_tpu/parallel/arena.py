@@ -87,7 +87,7 @@ for _cls in PREDICTABLE_CLASSES | {V_ORIGIN}:
     PREDICTABLE_MASK |= 1 << _cls
 
 
-def new_arena(capacity: int = 1 << 21, const_capacity: int = 1 << 17) -> Arena:
+def new_arena(capacity: int = 1 << 22, const_capacity: int = 1 << 18) -> Arena:
     return Arena(
         op=jnp.zeros(capacity, dtype=I32),
         a=jnp.zeros(capacity, dtype=I32),
@@ -214,7 +214,8 @@ class HostArena:
     memo survives across service rounds — shared condition prefixes convert
     to host terms exactly once per analysis, not once per service."""
 
-    def __init__(self, arena: Arena):
+    def __init__(self, arena: Arena, used: Optional[int] = None,
+                 used_const: Optional[int] = None):
         capacity = arena.capacity
         self.op = np.zeros(capacity, dtype=np.int32)
         self.a = np.zeros(capacity, dtype=np.int32)
@@ -229,38 +230,69 @@ class HostArena:
         self.n_const = 0
         self._memo: Dict[int, object] = {}
         self._var_memo: Dict[int, set] = {}
-        self.refresh(arena)
+        self.refresh(arena, used, used_const)
 
-    def refresh(self, arena: Arena) -> None:
-        """Mirror rows [self.n, arena.n) and consts [self.n_const, n_const)."""
+    def refresh(self, arena: Arena, used: Optional[int] = None,
+                used_const: Optional[int] = None) -> None:
+        """Mirror rows [self.n, arena.n) and consts [self.n_const, n_const).
+        Pass `used`/`used_const` if already known: each scalar int(arena.n)
+        on a device arena is a blocking ~30 ms tunnel read."""
+        self.refresh_apply(self.refresh_async(arena, used, used_const))
+
+    def refresh_async(self, arena: Arena, used: Optional[int] = None,
+                      used_const: Optional[int] = None):
+        """Dispatch the delta fetch and START its host copy without
+        blocking; `refresh_apply` consumes the handle. Lets the driver
+        overlap the (multi-MB) mirror transfer with the next fused chunk's
+        device compute instead of idling the device."""
         from .batch import next_pow2
 
-        used = int(arena.n)
-        used_const = int(arena.n_const)
+        if used is None:
+            used = int(arena.n)
+        if used_const is None:
+            used_const = int(arena.n_const)
         delta = used - self.n
         cdelta = used_const - self.n_const
         if delta <= 0 and cdelta <= 0:
-            return
+            return None
         bucket = min(max(next_pow2(max(delta, 1)), 16), self.op.shape[0])
         cbucket = min(max(next_pow2(max(cdelta, 1)), 16),
                       self.const_vals.shape[0])
         # clamp so start+bucket fits (dynamic_slice clamps the START, which
         # would silently misalign rows); compensate with a host-side offset
-        start = min(self.n, self.op.shape[0] - bucket)
-        cstart = min(self.n_const, self.const_vals.shape[0] - cbucket)
+        start = max(min(self.n, self.op.shape[0] - bucket), 0)
+        cstart = max(min(self.n_const, self.const_vals.shape[0] - cbucket),
+                     0)
         rows, consts = _fetch_delta_jit()(
-            arena, np.int32(max(start, 0)), np.int32(max(cstart, 0)),
+            arena, np.int32(start), np.int32(cstart),
             bucket=bucket, cbucket=cbucket)
+        for leaf in (rows, consts):
+            try:
+                leaf.copy_to_host_async()
+            except AttributeError:  # numpy-backed arena (tests)
+                pass
+        return rows, consts, start, cstart, used, used_const
+
+    def refresh_apply(self, handle) -> None:
+        """Fill the mirror from a refresh_async handle (blocks only if the
+        async copy has not finished streaming)."""
+        if handle is None:
+            return
+        rows, consts, start, cstart, used, used_const = handle
+        if used < self.n or used_const < self.n_const:
+            raise ValueError("arena mirror handles applied out of order")
         rows = np.asarray(rows)
         consts = np.asarray(consts)
+        delta = used - self.n
+        cdelta = used_const - self.n_const
         if delta > 0:
-            off = self.n - max(start, 0)
+            off = self.n - start
             for position, col in enumerate(_ROW_COLS):
                 getattr(self, col)[self.n:used] = \
                     rows[position, off:off + delta]
             self.n = used
         if cdelta > 0:
-            coff = self.n_const - max(cstart, 0)
+            coff = self.n_const - cstart
             self.const_vals[self.n_const:used_const] = \
                 consts[coff:coff + cdelta]
             self.n_const = used_const
@@ -386,8 +418,21 @@ class TxContext:
         self.calldata = calldata          # SymbolicCalldata
         self.environment = environment    # host Environment
         self.host_terms: list = []        # V_HOST_TERM leaves (BitVec)
+        #: (var_class, qualifier) -> BitVec. Device lanes allocate their own
+        #: VAR node per (lane, occurrence), so the HostArena node-id memo
+        #: misses on every lane — without this cache each materialized lane
+        #: re-ran calldata.get_word_at (a 32-byte If-chain build, profiled
+        #: at 80% of drain time on the 2^16-path bench)
+        self._var_cache: dict = {}
 
     def var(self, var_class: int, qualifier: int):
+        key = (var_class, qualifier)
+        hit = self._var_cache.get(key)
+        if hit is None:
+            hit = self._var_cache[key] = self._var(var_class, qualifier)
+        return hit
+
+    def _var(self, var_class: int, qualifier: int):
         from ..smt import symbol_factory
 
         env = self.environment

@@ -6,16 +6,18 @@ Every open world state seeds one device lane (pc=0, symbolic calldata/env,
 storage table from the world state); the batch runs fused symbolic steps
 (parallel/symstep.py) until lanes pause or leave:
 
-  - Symbolic JUMPIs fork ON DEVICE (symstep.sym_step's fork block): the lane
-    claims a DEAD lane, both sides append a signed condition id, and the pair
-    keeps stepping inside the same fused loop — no host service, no batch
-    round-trip. Forks are OPTIMISTIC end to end, exactly like the host
-    engine's jumpi_ (and the reference's): no solver runs during
-    exploration; path conditions ride along as arena ids and are solved only
-    where the host engine solves them — at issue/witness time
-    (MYTHRIL_TPU_CHECK_ESCAPES=1 opts back into escape-time pruning).
-    Saturated forkers WAIT frozen and the fork block revives them as escapes
-    free lanes; a full-batch deadlock hands the wave to the host.
+  - Symbolic JUMPIs fork ON DEVICE (symstep.sym_step's fork block): the
+    forker takes the jump; its fall-through sibling claims a DEAD lane
+    (width) or is PUSHED onto the scheduler's HBM sibling stack (depth) —
+    DEAD lanes pop the deepest sibling next step, so the batch runs a DFS
+    worklist entirely in HBM (symstep.DeviceScheduler). Forks are
+    OPTIMISTIC end to end, exactly like the host engine's jumpi_ (and the
+    reference's): no solver runs during exploration; path conditions ride
+    along as arena ids and are solved only where the host engine solves
+    them — at issue/witness time (MYTHRIL_TPU_CHECK_ESCAPES=1 opts back
+    into escape-time pruning). Escaping lanes buffer their row in the HBM
+    escape buffer and free instantly; the host bulk-drains buffered rows
+    in bandwidth-sized light transfers.
   - Conditions whose taint cone (arena cls bitmask) contains tx.origin or
     block attributes are NOT forked on device: the lane escapes at the JUMPI
     so the dependence detectors see it exactly as in host-only exploration.
@@ -37,14 +39,16 @@ import os
 from copy import copy
 from typing import Dict, List, Optional, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.state.global_state import GlobalState
 from ..exceptions import UnsatError
-from ..smt import Bool, symbol_factory
+from ..smt import Bool, Extract, symbol_factory
 from ..smt import terms as T
 from . import arena as A
 from . import symstep
+from . import words
 from .batch import (DEAD, ERRORED, ESCAPED, FORKING, RUNNING, StateBatch,
                     LaneSpec, build_batch)
 
@@ -52,10 +56,10 @@ log = logging.getLogger(__name__)
 
 #: stop the device phase when the arena has less head-room than this
 ARENA_HEADROOM = 16_384
-#: fused steps between host services (the tunnel round-trip is ~0.1 ms but
-#: each fused step at 512 lanes is ~5 ms of device work — the chunk bounds
-#: how long freshly-frozen lanes wait for service, not dispatch overhead)
-CHUNK = 32
+#: fused steps between summaries (the tunnel round-trip is ~0.1 ms but each
+#: fused step at 4096 lanes is ~25 ms of device work — the chunk bounds how
+#: long cold-SLOAD pauses wait for service, not dispatch overhead)
+CHUNK = 64
 #: hard step budget per transaction phase
 MAX_STEPS = 4_096
 #: device lanes (seeds + fork capacity)
@@ -84,31 +88,139 @@ def _scatter_rows(state, planes, index, rows_state, rows_planes):
         (state, planes), (rows_state, rows_planes))
 
 
-def _pool_write(pool, state, planes, slots, lanes):
-    """Copy `lanes`' rows into pool rows `slots`, entirely on device (the
-    pending pool lives in HBM; spilling costs no host transfer). Padded
-    entries: slot = pool capacity (write dropped), lane = a repeat of a real
-    lane (its gather is harmless)."""
-    import jax
+def _summary(state, planes, arena, sched):
+    """Everything the driver needs per chunk, packed into ONE int64 vector:
+    the tunnel charges a ~30 ms FLOOR per fetched array, so a 13-leaf tuple
+    costs ~400 ms while this single [13 + 2B] download costs one floor.
+    Layout: [stack_top, esc_count, executed, forks, pushes, pops, arena_n,
+    arena_n_const, esc_msize_max, esc_sp_max, esc_slots_max, esc_conds_max,
+    batch] then status[B] then fork_cond[B]."""
+    esc_rows = sched.esc_state.status.shape[0]
+    live = jnp.arange(esc_rows) < sched.esc_count
 
-    return jax.tree_util.tree_map(
-        lambda p, s: p.at[slots].set(s[lanes], mode="drop"),
-        pool, (state, planes))
+    def live_max(column):
+        return jnp.max(jnp.where(live, column, 0))
+
+    batch = state.status.shape[0]
+    scalars = jnp.stack([
+        sched.stack_top.astype(jnp.int64), sched.esc_count.astype(jnp.int64),
+        sched.executed, sched.forks, sched.pushes, sched.pops,
+        arena.n.astype(jnp.int64), arena.n_const.astype(jnp.int64),
+        live_max(sched.esc_state.msize).astype(jnp.int64),
+        live_max(sched.esc_state.sp).astype(jnp.int64),
+        live_max(jnp.sum(sched.esc_state.storage_used,
+                         axis=1, dtype=jnp.int32)).astype(jnp.int64),
+        live_max(sched.esc_planes.cond_count).astype(jnp.int64),
+        jnp.asarray(batch, dtype=jnp.int64),
+    ])
+    return jnp.concatenate([scalars, state.status.astype(jnp.int64),
+                            planes.fork_cond.astype(jnp.int64)])
 
 
-def _pool_read(pool, state, planes, lanes, slots):
-    """Copy pool rows `slots` back into `lanes` (re-seeding), on device."""
-    import jax
+#: _drain_light int32-section field layout: (name, per-row element count fn)
+_DRAIN_I32_FIELDS = ("pc", "sp", "msize", "code_len", "cond_count", "ctx_id")
 
-    return jax.tree_util.tree_map(
-        lambda s, p: s.at[lanes].set(p[slots], mode="drop"),
-        (state, planes), pool)
+
+def _pack_rows(state_like, planes_like, index, mem_b: int, sp_b: int,
+               st_b: int, conds_w: int):
+    """Gather `index`'s rows and pack ONLY what materialization reads
+    (per-field, sliced to the callers' maxima) into THREE flat arrays
+    (i32 / u8 / i64) before they cross the tunnel: full rows are ~40 KB,
+    every separate array pays a ~30 ms floor, and bandwidth is ~35 MB/s —
+    the one full-pytree gather this replaced cost 44 floors per call.
+    Works on the lane batch and on scheduler pools alike."""
+    from jax import lax
+
+    s, p = state_like, planes_like
+
+    def b32(x):
+        return lax.bitcast_convert_type(x, jnp.int32)
+
+    i32 = jnp.concatenate([
+        s.pc[index], s.sp[index], s.msize[index], s.code_len[index],
+        p.cond_count[index], p.ctx_id[index],
+        b32(s.stack[index][:, :sp_b]).reshape(-1),
+        b32(s.storage_keys[index][:, :st_b]).reshape(-1),
+        b32(s.storage_vals[index][:, :st_b]).reshape(-1),
+        p.stack_sym[index][:, :sp_b].reshape(-1),
+        p.mem_sym[index][:, :mem_b].reshape(-1),
+        p.storage_sym[index][:, :st_b].reshape(-1),
+        p.conds[index][:, :conds_w].reshape(-1),
+    ])
+    u8 = jnp.concatenate([
+        s.memory[index][:, :mem_b].reshape(-1),
+        s.storage_used[index][:, :st_b].astype(jnp.uint8).reshape(-1),
+        p.storage_dirty[index][:, :st_b].astype(jnp.uint8).reshape(-1),
+    ])
+    return i32, u8, s.gas_used[index]
+
+
+def _row_maxima(state_like, planes_like, index):
+    """Packed [msize_max, sp_max, used_slots_max, cond_count_max] over the
+    selected rows — sizes _pack_rows' static slices in one tiny fetch."""
+    return jnp.stack([
+        jnp.max(state_like.msize[index]).astype(jnp.int64),
+        jnp.max(state_like.sp[index]).astype(jnp.int64),
+        jnp.max(jnp.sum(state_like.storage_used[index],
+                        axis=1, dtype=jnp.int32)).astype(jnp.int64),
+        jnp.max(planes_like.cond_count[index]).astype(jnp.int64),
+    ])
+
+
+def _drain_unpack(i32, u8, gas, bucket: int, mem_b: int, sp_b: int,
+                  st_b: int, conds_w: int):
+    """Host-side inverse of _drain_light's packing."""
+    from . import words
+
+    limbs = words.NLIMBS
+    i32 = np.asarray(i32)
+    u8 = np.asarray(u8)
+    offset = [0]
+
+    def cut(count, shape=None, view=None):
+        part = i32[offset[0]:offset[0] + count]
+        offset[0] += count
+        if view is not None:
+            part = part.view(view)
+        return part.reshape(shape) if shape else part
+
+    rows_state = {}
+    rows_planes = {}
+    for field in _DRAIN_I32_FIELDS:
+        target = rows_planes if field in ("cond_count", "ctx_id") \
+            else rows_state
+        target[field] = cut(bucket)
+    rows_state["stack"] = cut(bucket * sp_b * limbs,
+                              (bucket, sp_b, limbs), np.uint32)
+    rows_state["storage_keys"] = cut(bucket * st_b * limbs,
+                                     (bucket, st_b, limbs), np.uint32)
+    rows_state["storage_vals"] = cut(bucket * st_b * limbs,
+                                     (bucket, st_b, limbs), np.uint32)
+    rows_planes["stack_sym"] = cut(bucket * sp_b, (bucket, sp_b))
+    rows_planes["mem_sym"] = cut(bucket * mem_b, (bucket, mem_b))
+    rows_planes["storage_sym"] = cut(bucket * st_b, (bucket, st_b))
+    rows_planes["conds"] = cut(bucket * conds_w, (bucket, conds_w))
+    rows_state["memory"] = u8[:bucket * mem_b].reshape(bucket, mem_b)
+    rows_state["storage_used"] = u8[
+        bucket * mem_b:bucket * (mem_b + st_b)].reshape(
+            bucket, st_b).astype(bool)
+    rows_planes["storage_dirty"] = u8[
+        bucket * (mem_b + st_b):bucket * (mem_b + 2 * st_b)].reshape(
+            bucket, st_b).astype(bool)
+    rows_state["gas_used"] = np.asarray(gas)
+    return rows_state, rows_planes
+
+
+def _reset_esc(sched):
+    return sched._replace(esc_count=jnp.zeros_like(sched.esc_count))
 
 
 _gather_rows_jit = None
 _scatter_rows_jit = None
-_pool_write_jit = None
-_pool_read_jit = None
+_summary_jit = None
+_pack_rows_jit = None
+_row_maxima_jit = None
+_reset_esc_jit = None
 
 
 def _gather_rows_compiled():
@@ -129,22 +241,42 @@ def _scatter_rows_compiled():
     return _scatter_rows_jit
 
 
-def _pool_write_compiled():
-    global _pool_write_jit
-    if _pool_write_jit is None:
+def _summary_compiled():
+    global _summary_jit
+    if _summary_jit is None:
         import jax
 
-        _pool_write_jit = jax.jit(_pool_write)
-    return _pool_write_jit
+        _summary_jit = jax.jit(_summary)
+    return _summary_jit
 
 
-def _pool_read_compiled():
-    global _pool_read_jit
-    if _pool_read_jit is None:
+def _pack_rows_compiled():
+    global _pack_rows_jit
+    if _pack_rows_jit is None:
         import jax
 
-        _pool_read_jit = jax.jit(_pool_read)
-    return _pool_read_jit
+        _pack_rows_jit = jax.jit(
+            _pack_rows,
+            static_argnames=("mem_b", "sp_b", "st_b", "conds_w"))
+    return _pack_rows_jit
+
+
+def _row_maxima_compiled():
+    global _row_maxima_jit
+    if _row_maxima_jit is None:
+        import jax
+
+        _row_maxima_jit = jax.jit(_row_maxima)
+    return _row_maxima_jit
+
+
+def _reset_esc_compiled():
+    global _reset_esc_jit
+    if _reset_esc_jit is None:
+        import jax
+
+        _reset_esc_jit = jax.jit(_reset_esc)
+    return _reset_esc_jit
 
 
 class LaneContext(A.TxContext):
@@ -206,43 +338,65 @@ class _Frontier:
         #: Feasibility is decided where the host decides it: at issue time.
         self.check_escapes = os.environ.get(
             "MYTHRIL_TPU_CHECK_ESCAPES") == "1"
-        #: escapes accumulate until this many lanes are waiting before a
-        #: host service runs (amortizes the tunnel round-trip + Python
-        #: materialization over many lanes); cold-SLOAD pauses and full
-        #: stalls still service immediately
-        self.service_lanes = int(os.environ.get(
-            "MYTHRIL_TPU_SERVICE_LANES", max(1, n_lanes // 8)))
-        #: the host-side overflow worklist of RAW device rows: when the fork
-        #: tree's live width exceeds the lane count, the SHALLOWEST waiting
-        #: forkers spill here as numpy rows (no term conversion — arena ids
-        #: stay valid) and re-seed into freed lanes deepest-first. The lane
-        #: batch + this queue form a DFS worklist machine: spilling shallow
-        #: keeps device lanes on deep paths that complete (and free lanes)
-        #: soon. Round 4's alternative — materialize the whole wave to the
-        #: host on saturation — ended the device phase at tree depth
-        #: log2(n_lanes) and surrendered the rest of the exploration.
+        #: (signed cond id, ctx index) -> Bool (see _cond_bools)
+        self._cond_memo: Dict[Tuple[int, int], Bool] = {}
+        #: drained-but-unmaterialized row blocks: [rows_state, rows_planes,
+        #: count, cursor]. The svm exec loop pulls batches on demand via
+        #: make_feeder() — materialization is LAZY, so rows the budget never
+        #: reaches cost nothing (host-timeout parity), and the device loop
+        #: never stalls on per-row Python GlobalState construction.
+        self.deferred: List[list] = []
+        #: escape rows accumulate in the DEVICE buffer until this many
+        #: wait, then the host drains them in one bandwidth-sized light
+        #: transfer
+        self.drain_batch = int(os.environ.get(
+            "MYTHRIL_TPU_DRAIN_BATCH", max(4 * n_lanes, 1024)))
+        #: host overflow tier: raw rows land here only when the DEVICE
+        #: scheduler cannot hold them (sibling stack full at total
+        #: deadlock) or on checkpoint/resume; they re-seed into DEAD lanes
+        #: once the device stack is empty. Scheduling itself lives on
+        #: device (symstep.DeviceScheduler) — the tunnel charges ~100 ms
+        #: per host-argument upload, so per-service host decisions are
+        #: poison.
         self.pending: List[Tuple[Dict[str, np.ndarray],
                                  Dict[str, np.ndarray]]] = []
-        self.spilled = 0
-        self.reseeded = 0
-        #: device-resident pending pool: spilled rows live in HBM and move
-        #: by on-device scatter/gather; only slot bookkeeping (free list +
-        #: per-slot depth) lives on host. The numpy `pending` list above is
-        #: the overflow tier (pool full) and the checkpoint/hand-over format.
-        self.pool = None
-        self.pool_free: List[int] = []
-        self.pool_depth: Dict[int, int] = {}
-        self.pool_bytes = int(os.environ.get(
-            "MYTHRIL_TPU_POOL_BYTES", 1 << 30))
+        self.spilled = 0    # host-tier spills (device stack overflow)
+        self.reseeded = 0   # host-tier reseeds (pending -> lanes)
+        self.stack_pushes = 0  # device DFS-stack siblings pushed
+        self.stack_pops = 0    # device DFS-stack siblings reseeded
+        #: scheduler pool byte budgets (HBM)
+        self.stack_bytes = int(os.environ.get(
+            "MYTHRIL_TPU_STACK_BYTES", 3 << 30))
+        self.esc_bytes = int(os.environ.get(
+            "MYTHRIL_TPU_ESC_BYTES", 1 << 30))
 
-    def _harena(self) -> A.HostArena:
+    def _harena(self, used=None, used_const=None) -> A.HostArena:
         """The persistent incremental host mirror of the arena (term memo
-        survives across services; only newly-allocated rows transfer)."""
+        survives across services; only newly-allocated rows transfer).
+        Pass `used`/`used_const` when the driver already fetched them in
+        the chunk summary — each scalar int(arena.n) is otherwise a ~30 ms
+        blocking tunnel read."""
         if self.harena is None:
-            self.harena = A.HostArena(self.arena)
+            self.harena = A.HostArena(self.arena, used, used_const)
         else:
-            self.harena.refresh(self.arena)
+            self.harena.refresh(self.arena, used, used_const)
         return self.harena
+
+    def _new_sched(self, state: StateBatch, planes):
+        """Size the on-device scheduler pools by HBM byte budget."""
+        row_bytes = sum(
+            int(np.dtype(leaf.dtype).itemsize) * int(np.prod(leaf.shape[1:]))
+            for leaf in list(state) + list(planes))
+        stack_rows = int(max(2 * self.n_lanes,
+                             min(1 << 17,
+                                 self.stack_bytes // max(row_bytes, 1))))
+        esc_rows = int(max(2 * self.n_lanes,
+                           min(1 << 16,
+                               self.esc_bytes // max(row_bytes, 1))))
+        log.info("device scheduler: %d stack + %d escape rows x %d B "
+                 "(%.0f MiB HBM)", stack_rows, esc_rows, row_bytes,
+                 (stack_rows + esc_rows) * row_bytes / 2 ** 20)
+        return symstep.new_scheduler(state, planes, stack_rows, esc_rows)
 
     # -- seeding -----------------------------------------------------------------------
 
@@ -363,7 +517,7 @@ class _Frontier:
     # -- host services -----------------------------------------------------------------
 
     def run(self, state: StateBatch, planes: symstep.SymPlanes) -> None:
-        import os
+        import jax
 
         from ..core.time_handler import time_handler
 
@@ -392,19 +546,14 @@ class _Frontier:
                                 "device phase fresh", resume_path, error)
                 os.environ.pop("MYTHRIL_TPU_RESUME", None)  # consume once
                 self.laser._device_resume_path = None
-        steps = 0
-        services = 0
         # ONE jit signature: numpy rows written by host services must be
         # re-canonicalized to device arrays, or the next fused call sees a
         # host-placed argument signature and XLA recompiles the whole step
-        # (~50s on the remote-TPU path — measured eating the entire bench
-        # budget mid-run)
         state, planes = self._to_device(state, planes)
         # one fused chunk can allocate ~3 nodes/lane/step; the headroom
         # margin must cover a full chunk burst or symstep's overflow guard
-        # silently kills lanes (paths dropped from the report). A config
-        # whose burst cannot fit gets a LOUD host hand-over, not a margin
-        # too small to be safe
+        # kills lanes (paths dropped from the report). A config whose burst
+        # cannot fit gets a LOUD host hand-over, not an unsafe margin
         headroom = max(ARENA_HEADROOM, 4 * chunk * self.n_lanes)
         if headroom > self.arena.capacity // 2:
             log.warning(
@@ -414,58 +563,124 @@ class _Frontier:
                 "count", chunk, self.n_lanes, self.arena.capacity)
             self._hand_over_running(state, planes)
             return
-        import jax
-
+        sched = self._new_sched(state, planes)
+        stack_rows = sched.stack_state.status.shape[0]
+        # an unsatisfiable count trigger would silently degrade every drain
+        # to the frozen-ESCAPED overflow fallback
+        drain_batch = min(self.drain_batch,
+                          sched.esc_state.status.shape[0])
+        # counters are cumulative across transactions; the scheduler's
+        # device counters restart at 0 each phase
+        lane_base, fork_base = self.lane_steps, self.forks
+        push_base, pop_base = self.stack_pushes, self.stack_pops
+        steps = 0
         status = np.asarray(state.status)
+        arena_n = int(self.arena.n)
+        backlog = None  # fetched escape rows awaiting materialization
+        # the device may consume at most this fraction of the remaining
+        # execution budget: the rest belongs to the host continuation
+        # (detector hooks, deferred-row materialization, next-tx seeding)
+        frac = float(os.environ.get("MYTHRIL_TPU_DEVICE_FRAC", "0.85"))
+        device_deadline = time_handler.time_remaining() * min(max(frac, 0.05),
+                                                              1.0)
+        import time as time_module
+
+        phase_start = time_module.monotonic()
         while steps < max_steps:
-            if int(self.arena.n) > self.arena.capacity - headroom:
+            if arena_n > self.arena.capacity - headroom:
                 log.warning("arena head-room exhausted; handing remaining "
                             "lanes to the host")
                 break
             if time_handler.time_remaining() <= 1000:  # ms
                 log.info("execution budget exhausted; ending device phase")
                 break
-            status_before = status
-            state, planes, self.arena, executed = \
-                symstep.sym_step_many_counted(state, planes, self.arena,
-                                              chunk)
+            if (time_module.monotonic() - phase_start) * 1000 \
+                    > device_deadline:
+                log.info("device budget fraction (%.0f%%) consumed; the "
+                         "host continuation takes over", frac * 100)
+                break
+            state, planes, self.arena, sched = symstep.run_chunk(
+                state, planes, self.arena, sched, chunk)
             steps += chunk
-            # ONE bundled fetch per chunk (status + fork marker + executed
-            # count): each extra np.asarray(device_array) is a blocking
-            # tunnel round-trip
-            status, fork_cond, executed = (
-                np.asarray(leaf) for leaf in jax.device_get(
-                    (state.status, planes.fork_cond, executed)))
-            # exact on-device accounting (sym_step_many_counted): fork
-            # targets and revived forkers step mid-chunk where host-side
-            # status diffs cannot see them
-            self.lane_steps += int(executed)
-            # device forks = DEAD lanes claimed as fork targets (a revived
-            # frozen forker is the SAME path continuing, not a new fork);
-            # a claimed target may already have ESCAPED/paused again within
-            # the same chunk, so count any transition out of DEAD
-            self.forks += int(np.sum((status_before == DEAD)
-                                     & (status != DEAD)))
-            # service policy: escapes ACCUMULATE until service_lanes of them
-            # wait (or nothing can run) — frozen forkers revive on device as
-            # serviced escapes free lanes, so the only immediate-service
-            # cases are cold-SLOAD pauses (fork_cond == 0: the lane needs a
-            # host fault-in to make progress at all) and a fully-stalled batch
-            cold_pause = ((status == FORKING) & (fork_cond == 0)).any()
-            escaped_count = int(np.sum(status == ESCAPED))
-            if cold_pause or escaped_count >= self.service_lanes \
-                    or not (status == RUNNING).any():
-                state, planes = self._service(state, planes)
+            # PIPELINE: the chunk dispatch above is async — materialize the
+            # previously-fetched escape rows NOW, while the device steps
+            if backlog is not None:
+                self._flush_backlog(backlog)
+                backlog = None
+            # ONE small packed download per chunk: lane status, scheduler
+            # pointers/counters, arena fill, escape-row maxima. Everything
+            # else stays in HBM (the tunnel: ~30 ms floor PER ARRAY +
+            # ~35 MB/s down, ~100 ms floor up — per-service host decisions
+            # and multi-leaf fetches are unaffordable)
+            packed = np.asarray(jax.device_get(
+                _summary_compiled()(state, planes, self.arena, sched)))
+            (stack_top, esc_count, executed, forks, pushes, pops, arena_n,
+             arena_nc, esc_msize, esc_sp, esc_slots, esc_conds, _batch) = (
+                 int(v) for v in packed[:13])
+            status = packed[13:13 + self.n_lanes].astype(np.int32)
+            fork_cond = packed[13 + self.n_lanes:
+                               13 + 2 * self.n_lanes].astype(np.int32)
+            self.lane_steps = lane_base + executed
+            self.forks = fork_base + forks
+            self.stack_pushes = push_base + pushes
+            self.stack_pops = pop_base + pops
+            dirty = False  # host mutated lane state this round?
+            # cold-SLOAD pauses need a host fault-in to progress at all
+            cold = np.nonzero((status == FORKING) & (fork_cond == 0))[0]
+            if len(cold):
+                harena = self._harena(arena_n, arena_nc)
+                state, planes = self._service_cold(
+                    state, planes, status, [int(l) for l in cold], harena)
+                dirty = True
+            # escape-buffer overflow: lanes frozen ESCAPED are packed off
+            # to the deferred queue (lazy materialization) and freed
+            frozen = np.nonzero(status == ESCAPED)[0]
+            if len(frozen):
+                self._harena(arena_n, arena_nc)
+                self._defer_lanes(state, planes, frozen)
+                status[frozen] = DEAD
+                dirty = True
+            # total deadlock with the sibling stack full: spill half the
+            # waiting forkers to the host overflow tier
+            waiting = (status == FORKING) & (fork_cond != 0)
+            if waiting.any() and not (status == RUNNING).any() \
+                    and not (status == DEAD).any() \
+                    and stack_top >= stack_rows:
+                lanes = np.nonzero(waiting)[0]
+                self._spill_host(state, planes, status,
+                                 [int(l) for l in lanes[:max(1, len(lanes)
+                                                             // 2)]])
+                dirty = True
+            # bulk-drain the escape buffer: one batched light transfer now,
+            # Python materialization deferred past the next chunk dispatch
+            if esc_count >= drain_batch or (
+                    esc_count and stack_top == 0
+                    and not (status == RUNNING).any()):
+                backlog = self._fetch_escapes(sched, esc_count, esc_msize,
+                                              esc_sp, esc_slots, esc_conds,
+                                              arena_n, arena_nc)
+                sched = _reset_esc_compiled()(sched)
+                esc_count = 0
+            # host overflow rows re-enter once the device stack is empty
+            if self.pending and stack_top == 0 and (status == DEAD).any():
+                state, planes = self._reseed_host(state, planes, status)
+                dirty = True
+            if dirty:
+                state = state._replace(status=status)
                 state, planes = self._to_device(state, planes)
-                status = np.asarray(state.status)
-                services += 1
-                if checkpoint_path and services % 8 == 0:
-                    self.save_checkpoint(checkpoint_path, state, planes)
+            if checkpoint_path and steps % (chunk * 16) == 0:
+                self.save_checkpoint(checkpoint_path, state, planes, sched)
             if not ((status == RUNNING) | (status == FORKING)).any() \
-                    and not self.pending and not self.pool_depth:
+                    and stack_top == 0 and esc_count == 0 \
+                    and not self.pending:
+                self._flush_backlog(backlog)
                 return
-        # budget exhausted: surviving lanes continue on host
-        self._hand_over_running(state, planes)
+        # budget exhausted: surviving lanes + backlog continue on host.
+        # Timeout parity: with no budget left, fetched-but-unmaterialized
+        # rows are dropped exactly like the host's mid-worklist states
+        if time_handler.time_remaining() > 1000:
+            self._flush_backlog(backlog)
+        self._hand_over_running(state, planes, sched)
 
     def _lane_sharding(self):
         if self._lane_sharding_cache is not Ellipsis:
@@ -516,163 +731,108 @@ class _Frontier:
         return jax.device_put((state, planes), jax.tree_util.tree_map(
             lambda _: sharding, (state, planes)))
 
-    def _materialize_lanes(self, state: StateBatch, planes, harena,
-                           lanes) -> None:
-        """Batched materialization: gather the selected lanes' rows on
-        device, fetch them in one transfer, and materialize each row.
+    def _pack_async(self, state_like, planes_like, index, msize_m: int,
+                    sp_m: int, st_m: int, conds_m: int):
+        """Dispatch the quantized light pack and START its host copy; the
+        returned handle unpacks later (so the multi-MB transfer streams
+        while the device computes the next chunk).
 
-        The index is padded to a power-of-two bucket: every distinct gather
-        shape costs an XLA compile of ~40 kernels, and un-padded per-service
-        escape counts (1, 3, 5, ...) made compiles 90% of a profiled
-        analysis. Bucketing bounds that to ~log2(n_lanes) compiles."""
+        Quantized static slice sizes: every distinct (bucket, mem_b, sp_b,
+        st_b, conds_w) combination is its own XLA program (compile, then a
+        ~0.3 s cache read per process) — a few coarse steps beat exact
+        power-of-two fits."""
+        def quantize(value, steps_, cap):
+            for step in steps_:
+                if value <= step:
+                    return min(step, cap)
+            return cap
+
+        mem_b = quantize(msize_m, (1, 32, 512),
+                         planes_like.mem_sym.shape[1])
+        sp_b = quantize(sp_m, (4, 16), state_like.stack.shape[1])
+        st_b = quantize(st_m, (1, 8), state_like.storage_keys.shape[1])
+        conds_w = quantize(conds_m, (16,), planes_like.conds.shape[1])
+        i32, u8, gas = _pack_rows_compiled()(
+            state_like, planes_like, np.asarray(index, dtype=np.int32),
+            mem_b=mem_b, sp_b=sp_b, st_b=st_b, conds_w=conds_w)
+        for leaf in (i32, u8, gas):
+            try:
+                leaf.copy_to_host_async()
+            except AttributeError:  # numpy backend
+                pass
+        return i32, u8, gas, len(index), mem_b, sp_b, st_b, conds_w
+
+    @staticmethod
+    def _pack_apply(handle):
+        i32, u8, gas, bucket, mem_b, sp_b, st_b, conds_w = handle
+        return _drain_unpack(i32, u8, gas, bucket, mem_b, sp_b, st_b,
+                             conds_w)
+
+    def _pack_fetch(self, state_like, planes_like, index, msize_m: int,
+                    sp_m: int, st_m: int, conds_m: int):
+        """Synchronous pack + unpack (hand-over and fallback paths)."""
+        return self._pack_apply(self._pack_async(
+            state_like, planes_like, index, msize_m, sp_m, st_m, conds_m))
+
+    def _fetch_rows(self, state_like, planes_like, index):
+        """Shared maxima + light-pack fetch of selected rows: index padded
+        to a power-of-two bucket (pad repeats index[0]: fetched, unused) so
+        gather shapes and their XLA compiles stay bounded. Returns
+        (rows_state, rows_planes, count)."""
         import jax
 
         from .batch import next_pow2
 
-        index = np.asarray(lanes)
+        index = np.asarray(index)
         count = len(index)
+        if not count:
+            return None, None, 0
         bucket = next_pow2(count)
-        padded = np.zeros(bucket, dtype=np.int64)
-        padded[:count] = index  # tail repeats lane index[0]: fetched, unused
-        if count:
-            padded[count:] = index[0]
-        rows_state, rows_planes = jax.device_get(
-            _gather_rows_compiled()(state, planes,
-                                    padded.astype(np.int32)))
-        state_rows = {field: np.asarray(getattr(rows_state, field))
-                      for field in rows_state._fields}
-        planes_rows = {field: np.asarray(getattr(rows_planes, field))
-                       for field in rows_planes._fields}
+        padded = np.full(bucket, index[0], dtype=np.int32)
+        padded[:count] = index
+        maxima = np.asarray(jax.device_get(_row_maxima_compiled()(
+            state_like, planes_like, padded)))
+        rows_state, rows_planes = self._pack_fetch(
+            state_like, planes_like, padded, *(int(v) for v in maxima))
+        return rows_state, rows_planes, count
+
+    def _materialize_lanes(self, state: StateBatch, planes, harena,
+                           lanes) -> None:
+        """Batched materialization of selected lanes: one tiny maxima fetch
+        sizes the light pack, one bundled download moves the rows, then
+        per-row host GlobalState construction."""
+        rows_state, rows_planes, count = self._fetch_rows(state, planes,
+                                                          lanes)
         for row in range(count):
-            self._materialize_np(state_rows, planes_rows, harena, row)
+            self._materialize_np(rows_state, rows_planes, harena, row)
 
-    def _service(self, state: StateBatch, planes: symstep.SymPlanes):
-        """Harvest escaped/halted lanes, fork paused lanes, prune unsat."""
-        status = np.array(state.status)  # writable copy
-        harena = self._harena()
+    def _defer_lanes(self, state: StateBatch, planes, lanes) -> None:
+        """Pack selected lanes' rows to host RAM for lazy materialization
+        (escape-buffer overflow relief)."""
+        rows_state, rows_planes, count = self._fetch_rows(state, planes,
+                                                          lanes)
+        if count:
+            self.deferred.append([rows_state, rows_planes, count, 0])
 
-        # harvest: escaped lanes go to the host worklist. Their rows are
-        # gathered ON DEVICE and fetched in one batched transfer — per-lane
-        # per-field pulls cost 44 tunnel round-trips per escape and
-        # serialized the whole bench into materialization time
-        escaped = np.nonzero(status == ESCAPED)[0]
-        if len(escaped):
-            self._materialize_lanes(state, planes, harena, escaped)
-            status[escaped] = DEAD
-        # halted/errored lanes are done (the device executed STOP/RETURN/
-        # REVERT only via escape, so these are bookkeeping-only states)
-        for lane in np.nonzero((status == ERRORED))[0]:
-            status[lane] = DEAD
-
-        forking = np.nonzero(status == FORKING)[0]
-        waiting: List[int] = []
-        if len(forking):
-            # fork_cond == 0 marks a cold-SLOAD pause (needs the host
-            # fault-in service); != 0 marks a saturated forker WAITING for a
-            # free lane — those stay frozen: the device fork block revives
-            # them itself once escapes free capacity (round-3 lesson: host-
-            # servicing every saturated forker serialized the whole bench
-            # into per-lane solver calls)
-            fork_conds = np.asarray(planes.fork_cond)
-            cold = [int(lane) for lane in forking if fork_conds[lane] == 0]
-            if cold:
-                state, planes = self._service_cold(state, planes, status,
-                                                   cold, harena)
-            waiting = [int(lane) for lane in forking
-                       if fork_conds[lane] != 0]
-
-        free = int(np.sum(status == DEAD))
-        backlog = len(self.pool_depth) + len(self.pending)
-        # re-seed spilled rows into freed lanes, DEEPEST first: the device
-        # works the bottom of the tree while shallow rows wait
-        if backlog and free:
-            # when waiters exist, reserve half the freed lanes as fork
-            # capacity — reseeding every DEAD lane with frozen forkers just
-            # ping-pongs rows back to the pool at the next service
-            quota = max(1, free // 2) if waiting else free
-            state, planes = self._reseed(state, planes, status,
-                                         min(quota, backlog))
-            free = int(np.sum(status == DEAD))
-        # saturation: waiting forkers but no claimable capacity — spill the
-        # SHALLOWEST half of them (fewest path conditions) so the survivors
-        # can fork into their lanes next chunk. Round 4 instead materialized
-        # the whole wave to the host here, which ended the device phase at
-        # tree depth log2(n_lanes) and surrendered the rest of the
-        # exploration to the Python worklist.
-        if waiting and not free:
-            if len(waiting) >= 2:
-                depths = np.asarray(planes.cond_count)[np.asarray(waiting)]
-                shallow = np.argsort(depths, kind="stable")[:len(waiting) // 2]
-                self._spill(state, planes, status,
-                            [waiting[i] for i in shallow],
-                            [int(depths[i]) for i in shallow])
-            elif not (status == RUNNING).any():
-                # a 1-waiter deadlock cannot make device progress: the host
-                # explores both branch sides from the frozen JUMPI
-                self._materialize_lanes(state, planes, harena, waiting)
-                status[np.asarray(waiting)] = DEAD
-        state = state._replace(status=np.asarray(status))
-        return state, planes
-
-    # -- pending-pool paging -----------------------------------------------------------
-
-    def _ensure_pool(self, state: StateBatch, planes) -> None:
-        """Allocate the HBM pending pool sized to MYTHRIL_TPU_POOL_BYTES
-        (default 1 GiB), capped at 2^16 rows."""
-        if self.pool is not None:
-            return
+    def _materialize_pool_prefix(self, pool_state, pool_planes, used: int,
+                                 harena) -> None:
+        """Materialize rows [0, used) of a scheduler pool (hand-over)."""
         import jax
-        import jax.numpy as jnp
 
-        row_bytes = sum(
-            int(np.dtype(leaf.dtype).itemsize) * int(np.prod(leaf.shape[1:]))
-            for leaf in list(state) + list(planes))
-        capacity = int(max(self.n_lanes,
-                           min(1 << 16, self.pool_bytes // max(row_bytes, 1))))
-        self.pool = jax.tree_util.tree_map(
-            lambda leaf: jnp.zeros((capacity,) + tuple(leaf.shape[1:]),
-                                   dtype=leaf.dtype), (state, planes))
-        self.pool_free = list(range(capacity))
-        log.info("pending pool: %d rows x %d B (%.0f MiB HBM)",
-                 capacity, row_bytes, capacity * row_bytes / 2 ** 20)
+        from .batch import next_pow2
 
-    def _spill(self, state: StateBatch, planes, status,
-               lanes: List[int], depths: List[int]) -> None:
-        """Move `lanes`' raw rows into the pending pool by on-device scatter
-        (no host transfer); overflow rows fall back to the numpy pending
-        list. Arena node ids inside the rows stay valid: append-only."""
-        self._ensure_pool(state, planes)
-        # deepest rows into the pool (they re-seed first); shallowest to the
-        # host overflow tier
-        order = sorted(range(len(lanes)), key=lambda i: depths[i],
-                       reverse=True)
-        n_pool = min(len(self.pool_free), len(lanes))
-        pool_rows = [lanes[i] for i in order[:n_pool]]
-        if pool_rows:
-            slots = [self.pool_free.pop() for _ in range(n_pool)]
-            # FIXED bucket (= n_lanes): the copy is device-side so padding
-            # is free, and one jit signature beats a fresh XLA compile per
-            # power-of-two spill size
-            bucket = self.n_lanes
-            pool_cap = self.pool[0].status.shape[0]
-            slots_arr = np.full(bucket, pool_cap, dtype=np.int32)  # pad: drop
-            slots_arr[:n_pool] = slots
-            lanes_arr = np.full(bucket, pool_rows[0], dtype=np.int32)
-            lanes_arr[:n_pool] = pool_rows
-            self.pool = _pool_write_compiled()(self.pool, state, planes,
-                                               slots_arr, lanes_arr)
-            for slot, i in zip(slots, order[:n_pool]):
-                self.pool_depth[slot] = depths[i]
-            status[np.asarray(pool_rows)] = DEAD
-        rest = [lanes[i] for i in order[n_pool:]]
-        if rest:
-            self._spill_host(state, planes, status, rest)
-        self.spilled += len(lanes)
+        if not used:
+            return
+        rows_state, rows_planes, count = self._fetch_rows(
+            pool_state, pool_planes, np.arange(used))
+        if count:
+            self.deferred.append([rows_state, rows_planes, count, 0])
 
     def _spill_host(self, state: StateBatch, planes, status,
                     lanes: List[int]) -> None:
         """Overflow tier: gather rows to the numpy pending list (one bundled
-        transfer)."""
+        transfer). Only reached when the DEVICE sibling stack is full at a
+        total deadlock — the scheduler handles everything else in HBM."""
         import jax
 
         from .batch import next_pow2
@@ -690,87 +850,133 @@ class _Frontier:
                 {field: np.asarray(getattr(rows_planes, field)[row])
                  for field in rows_planes._fields}))
         status[index] = DEAD
+        self.spilled += len(index)
 
-    def _drain_pool_to_pending(self) -> None:
-        """Pull every pool row to the host pending list (hand-over and
-        checkpoint serialization)."""
+    def _reseed_host(self, state: StateBatch, planes, status):
+        """Scatter pending overflow rows into DEAD lanes (bundled upload);
+        each row resumes with its own saved status (RUNNING sibling,
+        FORKING waiter, or ESCAPED row that re-buffers next chunk)."""
+        from .batch import next_pow2
+
+        count = min(int(np.sum(status == DEAD)), len(self.pending))
+        if not count:
+            return state, planes
+        self.pending.sort(key=lambda rows: int(rows[1]["cond_count"]))
+        take = [self.pending.pop() for _ in range(count)]  # deepest first
+        lanes = np.nonzero(status == DEAD)[0][:count]
+        bucket = next_pow2(count)
+        index = np.full(bucket, self.n_lanes, dtype=np.int32)  # pad: drop
+        index[:count] = lanes
+        rows_state = {}
+        for field in StateBatch._fields:
+            rows = np.stack([rs[field] for rs, _ in take])
+            rows_state[field] = rows if bucket == count else np.concatenate(
+                [rows, np.zeros((bucket - count,) + rows.shape[1:],
+                                dtype=rows.dtype)])
+        rows_planes = {}
+        for field in symstep.SymPlanes._fields:
+            rows = np.stack([rp[field] for _, rp in take])
+            rows_planes[field] = rows if bucket == count else np.concatenate(
+                [rows, np.zeros((bucket - count,) + rows.shape[1:],
+                                dtype=rows.dtype)])
+        state, planes = _scatter_rows_compiled()(
+            state, planes, np.asarray(index),
+            StateBatch(**rows_state), symstep.SymPlanes(**rows_planes))
+        for position, lane in enumerate(lanes):
+            status[lane] = int(take[position][0]["status"])
+        self.reseeded += count
+        return state, planes
+
+    def _fetch_escapes(self, sched, esc_count: int, esc_msize: int,
+                       esc_sp: int, esc_slots: int, esc_conds: int,
+                       arena_n: int, arena_nc: int):
+        """Dispatch the LIGHT pack of the buffered escape rows + the arena
+        mirror delta, with host copies STARTED but not awaited. The driver
+        materializes the returned backlog entry after dispatching the next
+        fused chunk: both the multi-MB transfers and the per-row Python
+        GlobalState construction then overlap device compute."""
+        from .batch import next_pow2
+
+        if self.harena is None:
+            self.harena = A.HostArena(self.arena, 1, 0)  # empty mirror
+        delta_handle = self.harena.refresh_async(self.arena, arena_n,
+                                                 arena_nc)
+        esc_cap = sched.esc_state.status.shape[0]
+        bucket = min(next_pow2(max(esc_count, 1)), esc_cap)
+        index = np.zeros(bucket, dtype=np.int32)
+        index[:min(esc_count, bucket)] = np.arange(min(esc_count, bucket))
+        pack_handle = self._pack_async(
+            sched.esc_state, sched.esc_planes, index, esc_msize, esc_sp,
+            esc_slots, esc_conds)
+        return pack_handle, delta_handle, esc_count
+
+    def _flush_backlog(self, backlog) -> None:
+        """Land a drain's transfers in host RAM and queue the rows for
+        LAZY materialization (make_feeder); nothing is built eagerly."""
+        if backlog is None:
+            return
+        pack_handle, delta_handle, count = backlog
+        self.harena.refresh_apply(delta_handle)
+        rows_state, rows_planes = self._pack_apply(pack_handle)
+        self.deferred.append([rows_state, rows_planes, count, 0])
+
+    def make_feeder(self, batch_rows: int = 256):
+        """Refill callback for the svm exec loop: materialize up to
+        `batch_rows` deferred rows into the worklist; False when empty."""
+        def feeder() -> bool:
+            fed = 0
+            while self.deferred and fed < batch_rows:
+                entry = self.deferred[0]
+                rows_state, rows_planes, count, cursor = entry
+                take = min(count - cursor, batch_rows - fed)
+                for row in range(cursor, cursor + take):
+                    self._materialize_np(rows_state, rows_planes,
+                                         self.harena, row)
+                entry[3] += take
+                fed += take
+                if entry[3] >= count:
+                    self.deferred.pop(0)
+            return fed > 0
+
+        return feeder
+
+    def _drain_escapes(self, sched, esc_count: int, esc_msize: int,
+                       esc_sp: int, esc_slots: int, esc_conds: int,
+                       arena_n: int, arena_nc: int):
+        """Fetch + materialize in one go (hand-over/terminal paths)."""
+        self._flush_backlog(self._fetch_escapes(
+            sched, esc_count, esc_msize, esc_sp, esc_slots, esc_conds,
+            arena_n, arena_nc))
+        return _reset_esc_compiled()(sched)
+
+    def _sched_rows(self, sched) -> List[Tuple[Dict[str, np.ndarray],
+                                               Dict[str, np.ndarray]]]:
+        """Full rows still held by the device scheduler (sibling stack +
+        escape buffer), for checkpointing and hand-over. Read-only: the
+        scheduler is not mutated."""
         import jax
 
         from .batch import next_pow2
 
-        if not self.pool_depth:
-            return
-        slots = sorted(self.pool_depth, key=self.pool_depth.get)
-        bucket = next_pow2(len(slots))
-        padded = np.full(bucket, slots[0], dtype=np.int64)
-        padded[:len(slots)] = slots
-        rows_state, rows_planes = jax.device_get(
-            _gather_rows_compiled()(self.pool[0], self.pool[1],
-                                    padded.astype(np.int32)))
-        for row in range(len(slots)):
-            self.pending.append((
-                {field: np.asarray(getattr(rows_state, field)[row])
-                 for field in rows_state._fields},
-                {field: np.asarray(getattr(rows_planes, field)[row])
-                 for field in rows_planes._fields}))
-        self.pool_free.extend(self.pool_depth)
-        self.pool_depth.clear()
-        # keep pending depth-sorted ascending (reseed pops the deepest end)
-        self.pending.sort(key=lambda rows: int(rows[1]["cond_count"]))
-
-    def _reseed(self, state: StateBatch, planes, status, count: int):
-        """Fill `count` DEAD lanes from the backlog, deepest rows first:
-        pool rows by on-device gather, then host pending rows by bundled
-        scatter."""
-        from .batch import next_pow2
-
-        lanes = np.nonzero(status == DEAD)[0][:count]
-        taken = 0
-        if self.pool_depth:
-            slots = sorted(self.pool_depth, key=self.pool_depth.get,
-                           reverse=True)[:len(lanes)]
-            k = len(slots)
-            bucket = self.n_lanes  # fixed signature; device-side copy
-            lanes_arr = np.full(bucket, self.n_lanes, dtype=np.int32)  # drop
-            lanes_arr[:k] = lanes[:k]
-            slots_arr = np.full(bucket, slots[0], dtype=np.int32)
-            slots_arr[:k] = slots
-            state, planes = _pool_read_compiled()(self.pool, state, planes,
-                                                  lanes_arr, slots_arr)
-            for slot in slots:
-                del self.pool_depth[slot]
-                self.pool_free.append(slot)
-            status[lanes[:k]] = FORKING  # frozen at their JUMPI
-            taken = k
-        if taken < count and self.pending:
-            n_host = min(count - taken, len(self.pending))
-            self.pending.sort(key=lambda rows: int(rows[1]["cond_count"]))
-            take = [self.pending.pop() for _ in range(n_host)]
-            host_lanes = lanes[taken:taken + n_host]
-            bucket = next_pow2(n_host)
-            index = np.full(bucket, self.n_lanes, dtype=np.int32)
-            index[:n_host] = host_lanes
-            rows_state = {}
-            for field in StateBatch._fields:
-                rows = np.stack([rs[field] for rs, _ in take])
-                rows_state[field] = rows if bucket == n_host else \
-                    np.concatenate([rows, np.zeros(
-                        (bucket - n_host,) + rows.shape[1:],
-                        dtype=rows.dtype)])
-            rows_planes = {}
-            for field in symstep.SymPlanes._fields:
-                rows = np.stack([rp[field] for _, rp in take])
-                rows_planes[field] = rows if bucket == n_host else \
-                    np.concatenate([rows, np.zeros(
-                        (bucket - n_host,) + rows.shape[1:],
-                        dtype=rows.dtype)])
-            state, planes = _scatter_rows_compiled()(
-                state, planes, np.asarray(index),
-                StateBatch(**rows_state), symstep.SymPlanes(**rows_planes))
-            status[host_lanes] = FORKING
-            taken += n_host
-        self.reseeded += taken
-        return state, planes
+        rows: List[Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]] = []
+        for pool_state, pool_planes, used in (
+                (sched.stack_state, sched.stack_planes,
+                 int(sched.stack_top)),
+                (sched.esc_state, sched.esc_planes, int(sched.esc_count))):
+            if not used:
+                continue
+            bucket = min(next_pow2(used), pool_state.status.shape[0])
+            index = np.zeros(bucket, dtype=np.int32)
+            index[:used] = np.arange(used)
+            rows_state, rows_planes = jax.device_get(
+                _gather_rows_compiled()(pool_state, pool_planes, index))
+            for row in range(used):
+                rows.append((
+                    {field: np.asarray(getattr(rows_state, field)[row])
+                     for field in rows_state._fields},
+                    {field: np.asarray(getattr(rows_planes, field)[row])
+                     for field in rows_planes._fields}))
+        return rows
 
     def _service_cold(self, state: StateBatch, planes, status,
                       cold: List[int], harena):
@@ -849,13 +1055,25 @@ class _Frontier:
         status[lane] = RUNNING
 
     def _cond_bools(self, planes_np, harena, lane: int) -> List[Bool]:
-        ctx = self.contexts[int(planes_np["ctx_id"][lane])]
+        """Signed condition ids -> Bools, memoized per (id, context): tree
+        siblings share long condition prefixes, so across a drain of N
+        lanes most conds repeat — the memo turns the drain's dominant cost
+        (profiled at ~0.7 ms/lane) into dict hits."""
+        ctx_index = int(planes_np["ctx_id"][lane])
+        ctx = self.contexts[ctx_index]
+        memo = self._cond_memo
         bools: List[Bool] = []
         for position in range(int(planes_np["cond_count"][lane])):
             signed = int(planes_np["conds"][lane, position])
-            word = harena.to_term(abs(signed), ctx)
-            is_zero = T.bv_cmp("eq", word.raw, T.bv_const(0, 256))
-            bools.append(Bool(T.bool_not(is_zero) if signed > 0 else is_zero))
+            key = (signed, ctx_index)
+            cached = memo.get(key)
+            if cached is None:
+                word = harena.to_term(abs(signed), ctx)
+                is_zero = T.bv_cmp("eq", word.raw, T.bv_const(0, 256))
+                cached = Bool(T.bool_not(is_zero) if signed > 0
+                              else is_zero)
+                memo[key] = cached
+            bools.append(cached)
         return bools
 
     def _feasible(self, planes_np, harena, lane: int) -> bool:
@@ -882,8 +1100,6 @@ class _Frontier:
     # -- materialization ---------------------------------------------------------------
 
     def _materialize_np(self, state_np, planes_np, harena, lane: int):
-        from . import words
-        from ..smt import BitVec
 
         ctx = self.contexts[int(planes_np["ctx_id"][lane])]
         # OPTIMISTIC by default, matching the host engine's JUMPI exactly
@@ -935,8 +1151,6 @@ class _Frontier:
             mstate.mem_extend(0, msize)
             mem = state_np["memory"][lane][:msize]
             mem_sym = planes_np["mem_sym"][lane][:msize]
-            from ..smt import Extract
-
             for offset in np.nonzero(mem_sym)[0]:
                 marker = int(mem_sym[offset])
                 node, byte_index = marker >> 5, marker & 31
@@ -983,7 +1197,7 @@ class _Frontier:
     # -- checkpointing -----------------------------------------------------------------
 
     def save_checkpoint(self, path: str, state: StateBatch,
-                        planes: symstep.SymPlanes) -> None:
+                        planes: symstep.SymPlanes, sched=None) -> None:
         """Dense-array frontier checkpoint (SURVEY §5: 'dense arrays
         serialize trivially'): one .npz holding the device phase —
         StateBatch planes, symbolic planes, the USED prefix of the
@@ -994,7 +1208,12 @@ class _Frontier:
         continuation and are not re-created on resume."""
         if not path.endswith(".npz"):
             path += ".npz"  # np.savez appends it; keep save/resume agreeing
-        self._drain_pool_to_pending()  # pool rows serialize via pending
+        # scheduler-held rows (sibling stack + escape buffer) serialize as
+        # pending rows; the live scheduler is NOT mutated — on resume they
+        # re-enter through the host reseed path with their saved statuses
+        pending_rows = list(self.pending)
+        if sched is not None:
+            pending_rows += self._sched_rows(sched)
         arrays = {}
         for field in state._fields:
             arrays[f"state_{field}"] = np.asarray(getattr(state, field))
@@ -1013,13 +1232,13 @@ class _Frontier:
         arrays["counters"] = np.asarray(
             [self.forks, self.infeasible, self.materialized, self.lane_steps,
              self.spilled, self.reseeded])
-        if self.pending:
+        if pending_rows:
             for field in StateBatch._fields:
                 arrays[f"pend_state_{field}"] = np.stack(
-                    [rs[field] for rs, _ in self.pending])
+                    [rs[field] for rs, _ in pending_rows])
             for field in symstep.SymPlanes._fields:
                 arrays[f"pend_planes_{field}"] = np.stack(
-                    [rp[field] for _, rp in self.pending])
+                    [rp[field] for _, rp in pending_rows])
         arrays["identity"] = np.asarray(
             [self.n_lanes, len(self.contexts)])
         # V_HOST_TERM leaves index into per-context host_terms lists that
@@ -1095,37 +1314,47 @@ class _Frontier:
                      for field in symstep.SymPlanes._fields}))
         return state, planes
 
-    def _hand_over_running(self, state: StateBatch, planes) -> None:
+    def _hand_over_running(self, state: StateBatch, planes,
+                           sched=None) -> None:
         from ..core.time_handler import time_handler
 
         status = np.asarray(state.status)
-        # ESCAPED lanes may be pending here too: services are batched (run's
-        # service_lanes threshold), so a budget/arena break can land with
-        # un-harvested escapes — they continue on the host like live lanes
+        # frozen ESCAPED lanes (buffer overflow) continue on the host like
+        # live lanes; the scheduler's stack + escape buffer are the backlog
         live = np.nonzero((status == RUNNING) | (status == FORKING)
                           | (status == ESCAPED))[0]
-        backlog = len(self.pending) + len(self.pool_depth)
+        sched_backlog = 0
+        if sched is not None:
+            sched_backlog = int(sched.stack_top) + int(sched.esc_count)
+        backlog = len(self.pending) + sched_backlog
         if time_handler.time_remaining() <= 1000 and (len(live) or backlog):
             # execution budget exhausted: the host could not explore these
             # states either (its own timeout drops mid-worklist states the
             # same way)
             log.info("execution budget exhausted with %d live lanes + %d "
-                     "pending rows; dropping them (host-timeout parity)",
+                     "backlog rows; dropping them (host-timeout parity)",
                      len(live), backlog)
             return
         if not len(live) and not backlog:
             return
-        self._drain_pool_to_pending()
         harena = self._harena()
         if len(live):
             self._materialize_lanes(state, planes, harena, live)
-        # spilled rows never made it back onto the device: the host explores
-        # them from their frozen JUMPIs
+        # backlog rows never made it back onto the device: the host explores
+        # them from their saved positions. Scheduler pools drain through the
+        # LIGHT pack path — the full 44-leaf gather paid a ~30 ms tunnel
+        # floor per leaf and moved whole 40 KB rows
+        if sched is not None:
+            self._materialize_pool_prefix(sched.stack_state,
+                                          sched.stack_planes,
+                                          int(sched.stack_top), harena)
+            self._materialize_pool_prefix(sched.esc_state, sched.esc_planes,
+                                          int(sched.esc_count), harena)
         for row_state, row_planes in self.pending:
-            self._materialize_np(
+            self.deferred.append([
                 {field: value[None] for field, value in row_state.items()},
                 {field: value[None] for field, value in row_planes.items()},
-                harena, 0)
+                1, 0])
         del self.pending[:]
 
 
@@ -1202,10 +1431,14 @@ def execute_message_call_tpu(laser_evm, callee_address,
     state, planes = seeded
     frontier.run(state, planes)
     log.info("frontier: %d forks, %d storage fault-ins, %d infeasible "
-             "pruned, %d states materialized for the host (arena nodes: %d, "
-             "spilled %d / reseeded %d)",
+             "pruned, %d states materialized + %d deferred for the host "
+             "(arena nodes: %d, stack pushes/pops %d/%d, host "
+             "spills/reseeds %d/%d)",
              frontier.forks, frontier.faults, frontier.infeasible,
-             frontier.materialized, int(frontier.arena.n),
+             frontier.materialized,
+             sum(entry[2] - entry[3] for entry in frontier.deferred),
+             int(frontier.arena.n),
+             frontier.stack_pushes, frontier.stack_pops,
              frontier.spilled, frontier.reseeded)
     # cumulative counters for benchmarking/diagnostics (bench.py)
     laser_evm.frontier_lane_steps = getattr(
@@ -1216,5 +1449,19 @@ def execute_message_call_tpu(laser_evm, callee_address,
         # warm-up aid (bench.py): compile/load the device executable without
         # paying a full host continuation of the materialized states
         del laser_evm.work_list[:]
+        del frontier.deferred[:]
         return
-    laser_evm.exec()
+    # deferred escape rows materialize lazily as the exec loop drains the
+    # worklist dry — rows the budget never reaches are dropped with zero
+    # cost, exactly like the host engine's own states at timeout
+    laser_evm.frontier_feeder = frontier.make_feeder()
+    try:
+        laser_evm.exec()
+    finally:
+        laser_evm.frontier_feeder = None
+        if frontier.deferred:
+            dropped = sum(entry[2] - entry[3] for entry in frontier.deferred)
+            log.info("execution budget exhausted with %d deferred frontier "
+                     "rows unmaterialized; dropping them (host-timeout "
+                     "parity)", dropped)
+            del frontier.deferred[:]

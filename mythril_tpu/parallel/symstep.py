@@ -35,7 +35,8 @@ import numpy as np
 
 from . import arena as A
 from . import lockstep
-from .batch import DEAD, FORKING, RUNNING, StateBatch
+from .batch import (DEAD, ERRORED, ESCAPED, FORKING, RUNNING,
+                    StateBatch)
 
 I32 = jnp.int32
 
@@ -112,6 +113,65 @@ class SymPlanes(NamedTuple):
         )
 
 
+class DeviceScheduler(NamedTuple):
+    """The frontier's worklist machine, resident in HBM (the tunnel charges
+    ~100 ms per host-argument upload and ~30 ms + 35 MB/s per download, so
+    scheduling decisions cannot touch the host):
+
+      - `stack_*` is a DFS sibling stack: a forking lane that finds no DEAD
+        lane to claim PUSHES its fall-through sibling here and continues down
+        the taken side; DEAD lanes POP the deepest sibling at the next step.
+        This replaces round-4's freeze-and-wait (which deadlocked the batch
+        at tree depth log2(n_lanes) and handed everything to the host).
+      - `esc_*` is the escape buffer: a lane that halts or reaches a
+        host-owned instruction has its row copied here and is freed
+        immediately; the host bulk-drains rows in bandwidth-sized batches
+        instead of per-service gathers.
+      - counters accumulate on device; the host reads them in the per-chunk
+        summary fetch."""
+
+    stack_state: StateBatch    # [P] sibling rows
+    stack_planes: "SymPlanes"
+    stack_top: jnp.ndarray     # i32 — rows used
+    esc_state: StateBatch      # [E] escaped rows
+    esc_planes: "SymPlanes"
+    esc_count: jnp.ndarray     # i32 — rows used
+    executed: jnp.ndarray      # i64 — instruction-states stepped
+    forks: jnp.ndarray         # i64 — fork events (claims + pushes)
+    pushes: jnp.ndarray        # i64 — siblings pushed to the stack
+    pops: jnp.ndarray          # i64 — siblings reseeded from the stack
+    enabled: jnp.ndarray       # bool — False = legacy freeze/escape semantics
+
+
+def new_scheduler(state: StateBatch, planes: SymPlanes, stack_rows: int,
+                  esc_rows: int, disabled: bool = False) -> DeviceScheduler:
+    """Allocate scheduler pools shaped like (state, planes) rows. With
+    `disabled`, pushes/buffering/reseeds never engage — the legacy
+    freeze-and-escape semantics for callers without a driver."""
+    def rows(leaf, n):
+        return jnp.zeros((n,) + tuple(leaf.shape[1:]), dtype=leaf.dtype)
+
+    return DeviceScheduler(
+        stack_state=StateBatch(*[rows(leaf, stack_rows) for leaf in state]),
+        stack_planes=SymPlanes(*[rows(leaf, stack_rows) for leaf in planes]),
+        stack_top=jnp.asarray(0, dtype=I32),
+        esc_state=StateBatch(*[rows(leaf, esc_rows) for leaf in state]),
+        esc_planes=SymPlanes(*[rows(leaf, esc_rows) for leaf in planes]),
+        esc_count=jnp.asarray(0, dtype=I32),
+        executed=jnp.asarray(0, dtype=jnp.int64),
+        forks=jnp.asarray(0, dtype=jnp.int64),
+        pushes=jnp.asarray(0, dtype=jnp.int64),
+        pops=jnp.asarray(0, dtype=jnp.int64),
+        enabled=jnp.asarray(not disabled),
+    )
+
+
+def _where_rows(mask, rows, leaf):
+    """Per-lane row select with mask broadcast over trailing dims."""
+    return jnp.where(mask.reshape(mask.shape + (1,) * (leaf.ndim - 1)),
+                     rows, leaf)
+
+
 def _operand_syms(state: StateBatch, planes: SymPlanes, n: int):
     """Arena node of the n-th-from-top stack slot (0 where concrete)."""
     idx = jnp.clip(state.sp - n, 0, planes.stack_sym.shape[1] - 1)
@@ -126,13 +186,44 @@ def _range_has_sym(plane_row_any, off, size, cap):
     return jnp.any(in_range & (plane_row_any != 0), axis=1)
 
 
-def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena
-             ) -> Tuple[StateBatch, SymPlanes, A.Arena]:
+def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
+             sched: DeviceScheduler
+             ) -> Tuple[StateBatch, SymPlanes, A.Arena, DeviceScheduler]:
     """One symbolic lockstep step for the whole batch."""
     batch, slots = planes.stack_sym.shape
     mem_cap = planes.mem_sym.shape[1]
     lane = jnp.arange(batch)
+
+    # error-terminated lanes are done (the device escapes INVALID and
+    # transaction-end opcodes explicitly; ERRORED here covers stack
+    # under/overflow and out-of-gas bookkeeping, matching the round-4
+    # service's reap) — free them so forks/reseeds can claim the slot
+    state = state._replace(status=jnp.where(
+        state.status == ERRORED, I32(DEAD), state.status))
+
+    # ---- reseed DEAD lanes from the sibling stack (deepest = top first) -------------
+    pool_rows = sched.stack_state.status.shape[0]
+    dead0 = state.status == DEAD
+    rrank = jnp.cumsum(dead0.astype(I32)) - 1
+    take = dead0 & (rrank < sched.stack_top) & sched.enabled
+    src = jnp.clip(sched.stack_top - 1 - rrank, 0,
+                   max(pool_rows - 1, 0)).astype(I32)
+    state = StateBatch(*[
+        _where_rows(take, pool_leaf[src], leaf)
+        for leaf, pool_leaf in zip(state, sched.stack_state)])
+    planes = SymPlanes(*[
+        _where_rows(take, pool_leaf[src], leaf)
+        for leaf, pool_leaf in zip(planes, sched.stack_planes)])
+    n_taken = jnp.sum(take, dtype=I32)
+    sched = sched._replace(stack_top=sched.stack_top - n_taken,
+                           pops=sched.pops + n_taken.astype(jnp.int64))
+
     running = state.status == RUNNING
+    # instruction-state accounting ON device: reseeded lanes, claimed fork
+    # targets and revived forkers all step inside the fused loop where
+    # host-side status diffs cannot see them
+    sched = sched._replace(executed=sched.executed + jnp.sum(
+        running.astype(jnp.int64)))
 
     # ---- fetch (same as lockstep) ---------------------------------------------------
     in_code = state.pc < state.code_len
@@ -379,13 +470,43 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena
                                      storage_dirty=storage_dirty,
                                      fork_cond=fork_cond)
 
+    # ---- escape buffering (before forking: freed lanes are claimable) ---------------
+    # Halting / host-owned lanes move their row into the escape buffer and
+    # free the lane immediately; the host bulk-drains the buffer in light
+    # packed transfers. Buffer full -> the lane stays frozen ESCAPED and
+    # the next summary sends the driver down the direct-materialize
+    # fallback.
+    esc_rows = sched.esc_state.status.shape[0]
+    esc_now = (new_state.status == ESCAPED) & sched.enabled
+    erank = jnp.cumsum(esc_now.astype(I32)) - 1
+    put = esc_now & (erank < (esc_rows - sched.esc_count))
+    eslot = jnp.where(put, sched.esc_count + erank, esc_rows).astype(I32)
+    esc_state = StateBatch(*[
+        pool_leaf.at[eslot].set(leaf, mode="drop")
+        for pool_leaf, leaf in zip(sched.esc_state, new_state)])
+    esc_planes = SymPlanes(*[
+        pool_leaf.at[eslot].set(leaf, mode="drop")
+        for pool_leaf, leaf in zip(sched.esc_planes, new_planes)])
+    esc_used = sched.esc_count + jnp.sum(put, dtype=I32)
+    sched = sched._replace(esc_state=esc_state, esc_planes=esc_planes,
+                           esc_count=esc_used)
+    new_state = new_state._replace(
+        status=jnp.where(put, I32(DEAD), new_state.status))
+
     # ---- on-device JUMPI forking ----------------------------------------------------
-    # Forking lanes claim a DEAD lane each: the pair continues in the same
-    # fused loop with signed condition ids appended — no host service, no
-    # deepcopy (reference forks at instructions.py:1633,1658 via deepcopy).
-    # Feasibility is NOT checked here: lanes explore optimistically and the
-    # driver prunes unsat paths once, at materialization (the DelayConstraint
-    # "pending" pattern, SURVEY §7 stage 9, on device).
+    # A forking lane takes the jump and its fall-through sibling goes to
+    # ONE of three places, all inside the fused loop (reference forks at
+    # instructions.py:1633,1658 via deepcopy; here a fork is a row copy and
+    # one signed condition id per side):
+    #   claim — a DEAD lane exists: the sibling runs in parallel (width);
+    #   push  — batch saturated: the sibling row is pushed onto the
+    #           scheduler's DFS stack and reseeds a lane later (depth);
+    #   spill — stack ALSO full: the sibling row goes into the ESCAPE
+    #           buffer — it drains to the host as a light packed row and
+    #           the host explores that subtree within its own budget.
+    # Only with every tier full does the forker freeze (FORKING +
+    # fork_cond marker) for the driver. Feasibility is NOT checked here:
+    # lanes explore optimistically, exactly like the host engine's jumpi_.
     max_conds = planes.conds.shape[1]
     want = jumpi_fork | frozen_fork  # cond_room baked into both
     is_dead = new_state.status == DEAD
@@ -399,6 +520,15 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena
     target = jnp.where(have_target,
                        dead_map[jnp.clip(fork_rank, 0, batch - 1)],
                        batch).astype(I32)
+    # saturated forkers push their sibling onto the DFS stack
+    push_want = want & ~have_target & sched.enabled
+    push_rank = jnp.cumsum(push_want.astype(I32)) - 1
+    push = push_want & (push_rank < (pool_rows - sched.stack_top))
+    # stack full: the sibling spills into the escape buffer instead
+    spill_want = push_want & ~push
+    spill_rank = jnp.cumsum(spill_want.astype(I32)) - 1
+    spill = spill_want & (spill_rank < (esc_rows - esc_used))
+    act = have_target | push | spill
 
     # taken-side destination validity (dest = concrete stack top)
     code_cap = state.code.shape[1]
@@ -412,44 +542,82 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena
 
     # 1. prepare the forker row as the shared post-fork template: sp -= 2,
     #    gas charged, +cond appended, dead stack_sym slots cleared
-    sp_fork = jnp.where(have_target, state.sp - 2, new_state.sp)
-    gas_fork = jnp.where(have_target,
+    sp_fork = jnp.where(act, state.sp - 2, new_state.sp)
+    gas_fork = jnp.where(act,
                          state.gas_used + lockstep.GAS_MIN_T[op],
                          new_state.gas_used)
     conds_fork = new_planes.conds.at[
-        jnp.where(have_target, lane, batch), count].set(sym2, mode="drop")
-    ccount_fork = jnp.where(have_target, planes.cond_count + 1,
+        jnp.where(act, lane, batch), count].set(sym2, mode="drop")
+    ccount_fork = jnp.where(act, planes.cond_count + 1,
                             new_planes.cond_count)
     j_slots = jnp.arange(slots)
-    cleared = have_target[:, None] & (j_slots[None, :] >= sp_fork[:, None])
+    cleared = act[:, None] & (j_slots[None, :] >= sp_fork[:, None])
     ssym_fork = jnp.where(cleared, 0, new_planes.stack_sym)
     state_a = new_state._replace(sp=sp_fork, gas_used=gas_fork)
     planes_a = new_planes._replace(conds=conds_fork, cond_count=ccount_fork,
                                    stack_sym=ssym_fork)
 
-    # 2. duplicate the prepared rows into the claimed target lanes
-    state_b = StateBatch(*[
-        field.at[target].set(field, mode="drop") for field in state_a])
-    planes_b = SymPlanes(*[
-        field.at[target].set(field, mode="drop") for field in planes_a])
+    # 2. the fall-through SIBLING rows: pc+1, flipped condition sign,
+    #    RUNNING, no wait marker
+    sib_conds = conds_fork.at[
+        jnp.where(act, lane, batch), count].set(-sym2, mode="drop")
+    sib_state = state_a._replace(
+        pc=jnp.where(act, state.pc + 1, state_a.pc).astype(I32),
+        status=jnp.where(act, I32(RUNNING), state_a.status))
+    sib_planes = planes_a._replace(
+        conds=sib_conds,
+        fork_cond=jnp.where(act, 0, planes_a.fork_cond))
 
-    # 3. per-side divergence: forker takes the jump, target falls through;
-    #    the target's appended condition flips sign
-    pc_final = jnp.where(have_target, off_i.astype(I32), state_b.pc)
+    # 3a. claim: copy sibling rows into the claimed DEAD lanes
+    state_b = StateBatch(*[
+        field.at[target].set(sib, mode="drop")
+        for field, sib in zip(state_a, sib_state)])
+    planes_b = SymPlanes(*[
+        field.at[target].set(sib, mode="drop")
+        for field, sib in zip(planes_a, sib_planes)])
+
+    # 3b. push: scatter sibling rows onto the scheduler stack
+    dst = jnp.where(push, sched.stack_top + push_rank,
+                    pool_rows).astype(I32)
+    stack_state = StateBatch(*[
+        pool_leaf.at[dst].set(sib, mode="drop")
+        for pool_leaf, sib in zip(sched.stack_state, sib_state)])
+    stack_planes = SymPlanes(*[
+        pool_leaf.at[dst].set(sib, mode="drop")
+        for pool_leaf, sib in zip(sched.stack_planes, sib_planes)])
+    n_push = jnp.sum(push, dtype=I32)
+
+    # 3c. spill: scatter sibling rows into the escape buffer (after any
+    #     rows buffered by this step's escapes)
+    sdst = jnp.where(spill, esc_used + spill_rank, esc_rows).astype(I32)
+    esc_state = StateBatch(*[
+        pool_leaf.at[sdst].set(sib, mode="drop")
+        for pool_leaf, sib in zip(sched.esc_state, sib_state)])
+    esc_planes = SymPlanes(*[
+        pool_leaf.at[sdst].set(sib, mode="drop")
+        for pool_leaf, sib in zip(sched.esc_planes, sib_planes)])
+    n_spill = jnp.sum(spill, dtype=I32)
+    sched = sched._replace(
+        stack_state=stack_state, stack_planes=stack_planes,
+        stack_top=sched.stack_top + n_push,
+        esc_state=esc_state, esc_planes=esc_planes,
+        esc_count=esc_used + n_spill,
+        pushes=sched.pushes + (n_push + n_spill).astype(jnp.int64),
+        forks=sched.forks + jnp.sum(act).astype(jnp.int64))
+
+    # 4. forker divergence: take the jump (or die on an invalid dest)
+    pc_final = jnp.where(act, off_i.astype(I32), state_b.pc)
     status_final = jnp.where(
-        have_target, jnp.where(dest_ok, RUNNING, DEAD), state_b.status)
-    pc_final = pc_final.at[target].set(state.pc + 1, mode="drop")
-    status_final = status_final.at[target].set(I32(RUNNING), mode="drop")
-    conds_final = planes_b.conds.at[target, count].set(-sym2, mode="drop")
-    # the fork is consumed: clear the waiting marker on BOTH sides (a stale
-    # marker would misclassify this lane's next pause as a fork-wait)
-    fcond_final = jnp.where(have_target, 0, planes_b.fork_cond)
-    fcond_final = fcond_final.at[target].set(0, mode="drop")
+        act, jnp.where(dest_ok, RUNNING, DEAD), state_b.status)
+    # the fork is consumed: clear the waiting marker (a stale marker would
+    # misclassify this lane's next pause as a fork-wait); non-act waiters
+    # keep theirs and freeze until capacity appears
+    fcond_final = jnp.where(act, 0, planes_b.fork_cond)
 
     new_state = state_b._replace(pc=pc_final, status=status_final)
-    new_planes = planes_b._replace(conds=conds_final,
-                                   fork_cond=fcond_final)
-    return new_state, new_planes, arena
+    new_planes = planes_b._replace(fork_cond=fcond_final)
+
+    return new_state, new_planes, arena, sched
 
 
 def _sym_stack_update(state: StateBatch, new_state: StateBatch,
@@ -501,31 +669,43 @@ def _sym_stack_update(state: StateBatch, new_state: StateBatch,
 
 
 @partial(jax.jit, static_argnames=("n_steps",))
-def sym_step_many(state: StateBatch, planes: SymPlanes, arena: A.Arena,
-                  n_steps: int):
-    """n_steps fused symbolic steps (stops forking lanes immediately: a
-    FORKING status freezes the lane until the driver services it)."""
+def run_chunk(state: StateBatch, planes: SymPlanes, arena: A.Arena,
+              sched: DeviceScheduler, n_steps: int):
+    """n_steps fused symbolic steps with the on-device scheduler engaged:
+    forks claim lanes or push siblings, DEAD lanes reseed from the stack,
+    escapes buffer — zero host involvement inside the chunk."""
     def body(_, carry):
         return sym_step(*carry)
 
-    return jax.lax.fori_loop(0, n_steps, body, (state, planes, arena))
+    return jax.lax.fori_loop(0, n_steps, body,
+                             (state, planes, arena, sched))
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def sym_step_many(state: StateBatch, planes: SymPlanes, arena: A.Arena,
+                  n_steps: int):
+    """Legacy driver-less entry: scheduler disabled, so forking lanes
+    freeze at saturation and escapes stay frozen ESCAPED (round-4
+    semantics for tests / the graft entry)."""
+    sched = new_scheduler(state, planes, 1, 1, disabled=True)
+
+    def body(_, carry):
+        return sym_step(*carry)
+
+    state, planes, arena, _ = jax.lax.fori_loop(
+        0, n_steps, body, (state, planes, arena, sched))
+    return state, planes, arena
 
 
 @partial(jax.jit, static_argnames=("n_steps",))
 def sym_step_many_counted(state: StateBatch, planes: SymPlanes,
                           arena: A.Arena, n_steps: int):
-    """sym_step_many plus an exact executed-instruction count, accumulated
-    ON DEVICE: lanes forked into mid-chunk and revived frozen forkers step
-    inside the fused loop where host-side before/after status diffs cannot
-    see them (the round-4 accounting credited a claimed fork target 0 steps
-    no matter how many it executed). One RUNNING lane stepping once == one
-    instruction-state, the same unit as the host engine's executed_nodes."""
-    def body(_, carry):
-        state, planes, arena, executed = carry
-        executed = executed + jnp.sum(
-            (state.status == RUNNING).astype(jnp.int64))
-        state, planes, arena = sym_step(state, planes, arena)
-        return state, planes, arena, executed
+    """Legacy entry plus the executed-instruction count (profiling)."""
+    sched = new_scheduler(state, planes, 1, 1, disabled=True)
 
-    return jax.lax.fori_loop(
-        0, n_steps, body, (state, planes, arena, jnp.int64(0)))
+    def body(_, carry):
+        return sym_step(*carry)
+
+    state, planes, arena, sched = jax.lax.fori_loop(
+        0, n_steps, body, (state, planes, arena, sched))
+    return state, planes, arena, sched.executed
