@@ -173,6 +173,7 @@ class IntegerArithmetics(DetectionModule):
             self._harvest(state, element)
 
     # -- resolution at transaction end ----------------------------------------------
+
     def _handle_transaction_end(self, state: GlobalState) -> List[Issue]:
         issues: List[Issue] = []
         container = _get_state_annotation(state)
@@ -222,3 +223,13 @@ class IntegerArithmetics(DetectionModule):
             attach_issue_annotation(state, issue, self, constraints)
             issues.append(issue)
         return issues
+
+
+def harvest_values(state, values) -> None:
+    """Harvest OverUnderflowAnnotations from `values` into `state`'s
+    container — the device frontier's stand-in for the SSTORE/JUMPI sink
+    pre-hooks on instructions it executed in the fused loop
+    (parallel/frontier.py materialization). Delegates to the module's own
+    sink rule so the two paths cannot diverge."""
+    for value in values:
+        IntegerArithmetics._harvest(state, value)

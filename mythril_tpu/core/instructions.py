@@ -847,7 +847,13 @@ class Instruction:
             retval = s.new_bitvec(f"retval_{instruction['address']}", 256)
             s.mstate.stack.append(retval)
             if with_value:
-                transfer_ether(s, s.environment.address, callee_address, value)
+                # get_callee_address renders concrete targets as hex STRINGS
+                # (call.py:56,71); the balance array indexes by BitVec
+                receiver = callee_address
+                if isinstance(receiver, str):
+                    receiver = symbol_factory.BitVecVal(int(receiver, 16),
+                                                        256)
+                transfer_ether(s, s.environment.address, receiver, value)
             s.world_state.constraints.append(Or(retval == 1, retval == 0))
             s.mstate.pc += 1
             return [s]

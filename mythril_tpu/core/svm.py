@@ -165,9 +165,13 @@ class LaserEVM:
         self.executed_transactions = True
         time_handler.start_execution(self.execution_timeout)
         self.time = datetime.now()
-        predicted_hashes = self._predicted_function_hashes(address)
+        # explicit user input wins: the reference applies
+        # args.transaction_sequences unconditionally in execute_message_call,
+        # so a tx_strategy's predictions must not shadow a CLI restriction
+        # (ADVICE r4)
+        predicted_hashes = self._cli_transaction_sequences()
         if not predicted_hashes:
-            predicted_hashes = self._cli_transaction_sequences()
+            predicted_hashes = self._predicted_function_hashes(address)
         start_tx, pending_work_list = 0, None
         if self.resume_path:
             from ..support.checkpoint import (load_host_checkpoint,
@@ -251,10 +255,17 @@ class LaserEVM:
             if tx_hashes is None:
                 hashes.append(None)
                 continue
-            hashes.append([
-                h if h in (-1, -2)
-                else bytes.fromhex(hex(h)[2:].zfill(8))
-                for h in tx_hashes])
+            converted = []
+            for h in tx_hashes:
+                if h in (-1, -2):
+                    converted.append(h)
+                elif isinstance(h, int) and 0 <= h < 2 ** 32:
+                    converted.append(h.to_bytes(4, "big"))
+                else:
+                    raise ValueError(
+                        f"--transaction-sequences entry {h!r} is not a "
+                        "4-byte selector or -1/-2")
+            hashes.append(converted)
         return hashes
 
     def _predicted_function_hashes(self, address) -> List[Optional[List]]:

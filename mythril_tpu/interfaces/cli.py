@@ -241,6 +241,23 @@ def main(argv=None) -> int:
                 str(cli_args.transaction_sequences))
         except (ValueError, SyntaxError):
             parser.error("--transaction-sequences is not a valid nested list")
+        # validate VALUES, not just shape: hex(h) mangles negative ints other
+        # than -1/-2 and selectors wider than 4 bytes would overflow the
+        # selector encoding downstream (ADVICE r4)
+        if not isinstance(cli_args.transaction_sequences, list):
+            parser.error("--transaction-sequences must be a nested list")
+        for tx_hashes in cli_args.transaction_sequences:
+            if tx_hashes is None:
+                continue
+            if not isinstance(tx_hashes, list):
+                parser.error("--transaction-sequences entries must be lists")
+            for h in tx_hashes:
+                if h in (-1, -2):
+                    continue
+                if not isinstance(h, int) or not 0 <= h < 2 ** 32:
+                    parser.error(
+                        f"--transaction-sequences value {h!r} is not a "
+                        "4-byte function selector or -1/-2")
         if len(cli_args.transaction_sequences) != cli_args.transaction_count:
             cli_args.transaction_count = len(cli_args.transaction_sequences)
     logging.basicConfig(

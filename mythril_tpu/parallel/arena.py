@@ -343,6 +343,13 @@ class HostArena:
                     raw = T.ite(T.bv_cmp("eq", cb.raw, T.bv_const(0, 256)),
                                 T.bv_const(0, 256), raw)
                 result = bv(raw)
+                if op in (0x01, 0x02, 0x03):
+                    # the integer detector's source hook fires at host
+                    # ADD/SUB/MUL executions; device-executed arithmetic
+                    # reconstructs the identical marker here (site address
+                    # rides in imm2) so sinks downstream harvest it
+                    self._attach_overflow_annotation(
+                        op, result, ca, cb, int(self.imm2[node_id]))
             elif op in _SHIFTS:
                 # EVM shift operand order: (shift, value)
                 result = bv(T.bv_binop(_SHIFTS[op], cb.raw, ca.raw))
@@ -379,6 +386,8 @@ class HostArena:
                     exponent_function_manager
 
                 result, _ = exponent_function_manager.create_condition(ca, cb)
+                self._attach_overflow_annotation(
+                    op, result, ca, cb, int(self.imm2[node_id]))
             elif op == 0x0F:  # internal: ite(cond=a, then=b, else=c)
                 cc = self._convert(int(self.c[node_id]), ctx)
                 cond = T.bool_not(T.bv_cmp("eq", ca.raw, T.bv_const(0, 256)))
@@ -387,6 +396,43 @@ class HostArena:
                 raise ValueError(f"arena node {node_id}: unknown op {op:#x}")
         memo[key] = result
         return result
+
+    @staticmethod
+    def _attach_overflow_annotation(op: int, result, ca, cb,
+                                    address: int) -> None:
+        """Device-executed ADD/SUB/MUL: attach the integer detector's
+        OverUnderflowAnnotation exactly as the host pre-hook would
+        (analysis/modules/integer.py _handle_add/_handle_sub/_handle_mul).
+        The overflowing 'state' is a light shim carrying the site address
+        and environment; the satisfiability pre-check then runs against
+        the annotation constraint alone — the final issue check uses the
+        sink state's constraints either way."""
+        from ..analysis.modules.integer import OverUnderflowAnnotation
+        from ..smt import (BVAddNoOverflow, BVMulNoOverflow,
+                           BVSubNoUnderflow, Not, UGT, symbol_factory)
+
+        if ca.raw.is_const and cb.raw.is_const:
+            return
+        if op == 0x01:
+            operator = "addition"
+            constraint = Not(BVAddNoOverflow(ca, cb, False))
+        elif op == 0x03:
+            operator = "subtraction"
+            constraint = Not(BVSubNoUnderflow(ca, cb, False))
+        elif op == 0x0A:
+            if ca.raw.is_const and ca.raw.value < 2:
+                return
+            operator = "exponentiation"
+            constraint = UGT(cb, symbol_factory.BitVecVal(255, 256))
+        else:
+            if (ca.raw.is_const and ca.raw.value < 2) or \
+                    (cb.raw.is_const and cb.raw.value < 2):
+                return
+            operator = "multiplication"
+            constraint = Not(BVMulNoOverflow(ca, cb, False))
+        result.annotate(OverUnderflowAnnotation(
+            _DeviceArithSite(ctx.environment, address), operator,
+            constraint))
 
     def var_classes(self, node_id: int) -> set:
         """All VAR classes reachable from node_id (drives detector-relevant
@@ -407,6 +453,27 @@ class HostArena:
                               int(self.c[node])))
         self._var_memo[int(node_id)] = classes
         return classes
+
+
+class _DeviceArithSite:
+    """Light stand-in for the GlobalState at a device-executed arithmetic
+    instruction — everything the integer detector reads from
+    annotation.overflowing_state (environment metadata, site address,
+    constraints for the pre-check)."""
+
+    class _WorldView:
+        def __init__(self):
+            from ..core.state.constraints import Constraints
+
+            self.constraints = Constraints()
+
+    def __init__(self, environment, address: int):
+        self.environment = environment
+        self.world_state = self._WorldView()
+        self._address = address
+
+    def get_current_instruction(self):
+        return {"address": self._address, "opcode": "ARITH"}
 
 
 class TxContext:

@@ -118,7 +118,8 @@ def _summary(state, planes, arena, sched):
 
 
 #: _drain_light int32-section field layout: (name, per-row element count fn)
-_DRAIN_I32_FIELDS = ("pc", "sp", "msize", "code_len", "cond_count", "ctx_id")
+_DRAIN_I32_FIELDS = ("pc", "sp", "msize", "code_len", "cond_count",
+                     "ctx_id", "last_jump")
 
 
 def _pack_rows(state_like, planes_like, index, mem_b: int, sp_b: int,
@@ -138,7 +139,7 @@ def _pack_rows(state_like, planes_like, index, mem_b: int, sp_b: int,
 
     i32 = jnp.concatenate([
         s.pc[index], s.sp[index], s.msize[index], s.code_len[index],
-        p.cond_count[index], p.ctx_id[index],
+        p.cond_count[index], p.ctx_id[index], p.last_jump[index],
         b32(s.stack[index][:, :sp_b]).reshape(-1),
         b32(s.storage_keys[index][:, :st_b]).reshape(-1),
         b32(s.storage_vals[index][:, :st_b]).reshape(-1),
@@ -187,7 +188,8 @@ def _drain_unpack(i32, u8, gas, bucket: int, mem_b: int, sp_b: int,
     rows_state = {}
     rows_planes = {}
     for field in _DRAIN_I32_FIELDS:
-        target = rows_planes if field in ("cond_count", "ctx_id") \
+        target = rows_planes if field in ("cond_count", "ctx_id",
+                                          "last_jump") \
             else rows_state
         target[field] = cut(bucket)
     rows_state["stack"] = cut(bucket * sp_b * limbs,
@@ -287,16 +289,22 @@ class LaneContext(A.TxContext):
         self.template = template
 
 
-def _storage_entries(storage
-                     ) -> Optional[Tuple[List[Tuple[int, object]], bool]]:
+def _storage_entries(storage) -> Tuple[List[Tuple[int, object]], bool]:
     """Walk the storage store-chain into ((concrete_key, BitVec_value) pairs,
     base_is_symbolic) — latest store wins. A symbolic BASE (every
     `--bin-runtime`/`-a` analysis: analysis/symbolic.py seeds
     `Array("Storage[...]")`, mirroring the reference's lazy Storage at
     mythril/laser/ethereum/state/account.py:18-76) is device-representable:
     cold SLOADs fault the slot in as Select(base, key) host-term leaves via
-    the driver's pause service. Only a symbolic KEY anywhere in the chain
-    returns None (device table aliasing would be unsound): host owns those."""
+    the driver's pause service.
+
+    A symbolic KEY in the chain (`mapping[msg.sender]` — every token
+    contract's tx 2+) stops the walk THERE: stores above it (which shadow
+    it) seed the device table; the store itself and everything below become
+    the symbolic base. A device SLOAD that misses the table faults in
+    `Select(full chain, key)` — the correct ITE over the symbolic-key
+    store — so the whole transaction stays device-resident where round 4
+    fell back to a pure host run."""
     from ..smt import BitVec
 
     node = storage._standard_storage.raw
@@ -304,12 +312,15 @@ def _storage_entries(storage
     while node.op == "store":
         key, value = node.args[1], node.args[2]
         if not key.is_const:
-            return None
+            # concrete-key stores BELOW this point may be shadowed when the
+            # symbolic key aliases them — they must stay out of the table
+            # and resolve through the fault-in chain select instead
+            return list(entries.items()), True
         entries.setdefault(key.value, BitVec(value))
         node = node.args[0]
     if node.op == "const_array":
         if not (node.args[0].is_const and node.args[0].value == 0):
-            return None
+            return list(entries.items()), True
         return list(entries.items()), False
     return list(entries.items()), True  # symbolic base: fault-in on demand
 
@@ -387,11 +398,15 @@ class _Frontier:
         row_bytes = sum(
             int(np.dtype(leaf.dtype).itemsize) * int(np.prod(leaf.shape[1:]))
             for leaf in list(state) + list(planes))
+        # bounded by HBM budget AND lane count: a 128-lane corpus analysis
+        # must not allocate (and zero) gigabytes of pool per transaction —
+        # the stack's worst case is ~lanes x tree depth, the escape buffer
+        # a few chunks of escape bursts
         stack_rows = int(max(2 * self.n_lanes,
-                             min(1 << 17,
+                             min(1 << 17, 24 * self.n_lanes,
                                  self.stack_bytes // max(row_bytes, 1))))
         esc_rows = int(max(2 * self.n_lanes,
-                           min(1 << 16,
+                           min(1 << 16, 8 * self.n_lanes,
                                self.esc_bytes // max(row_bytes, 1))))
         log.info("device scheduler: %d stack + %d escape rows x %d B "
                  "(%.0f MiB HBM)", stack_rows, esc_rows, row_bytes,
@@ -400,14 +415,11 @@ class _Frontier:
 
     # -- seeding -----------------------------------------------------------------------
 
-    def seed(self, seed_states: List[GlobalState]) -> Optional[StateBatch]:
+    def seed(self, seed_states: List[GlobalState]) -> Tuple:
         specs = []
         for template in seed_states:
             account = template.environment.active_account
-            walked = _storage_entries(account.storage)
-            if walked is None:
-                return None  # caller falls back to host for everything
-            entries, base_sym = walked
+            entries, base_sym = _storage_entries(account.storage)
             code_hex = template.environment.code.bytecode
             specs.append((template, entries, base_sym,
                           bytes.fromhex(code_hex[2:] if code_hex.startswith("0x")
@@ -669,11 +681,26 @@ class _Frontier:
                 state = state._replace(status=status)
                 state, planes = self._to_device(state, planes)
             if checkpoint_path and steps % (chunk * 16) == 0:
-                self.save_checkpoint(checkpoint_path, state, planes, sched)
+                # deferred rows live only in host RAM (neither the device
+                # npz nor the host pickle covers them): materialize them
+                # into the worklist first so the host checkpoint owns them
+                try:
+                    while self.deferred:
+                        rows_state, rows_planes, count, cursor = \
+                            self.deferred.pop(0)
+                        for row in range(cursor, count):
+                            self._materialize_np(rows_state, rows_planes,
+                                                 self.harena, row)
+                    self.save_checkpoint(checkpoint_path, state, planes,
+                                         sched)
+                except Exception as error:  # noqa: BLE001
+                    log.warning("periodic device checkpoint failed (%s); "
+                                "continuing without it", error)
             if not ((status == RUNNING) | (status == FORKING)).any() \
                     and stack_top == 0 and esc_count == 0 \
                     and not self.pending:
                 self._flush_backlog(backlog)
+                self._discard_checkpoint(checkpoint_path)
                 return
         # budget exhausted: surviving lanes + backlog continue on host.
         # Timeout parity: with no budget left, fetched-but-unmaterialized
@@ -681,6 +708,24 @@ class _Frontier:
         if time_handler.time_remaining() > 1000:
             self._flush_backlog(backlog)
         self._hand_over_running(state, planes, sched)
+        self._discard_checkpoint(checkpoint_path)
+
+    @staticmethod
+    def _discard_checkpoint(checkpoint_path) -> None:
+        """The device phase ended and its wave is fully on the host side:
+        a leftover .npz would graft this wave onto a LATER transaction's
+        fresh seeding on resume (same lane/context counts pass the
+        identity check) — delete it (ADVICE r4 medium)."""
+        if not checkpoint_path:
+            return
+        path = checkpoint_path if checkpoint_path.endswith(".npz") \
+            else checkpoint_path + ".npz"
+        try:
+            if os.path.exists(path):
+                os.remove(path)
+        except OSError as error:
+            log.warning("cannot remove completed device checkpoint %s: %s",
+                        path, error)
 
     def _lane_sharding(self):
         if self._lane_sharding_cache is not Ellipsis:
@@ -940,15 +985,6 @@ class _Frontier:
 
         return feeder
 
-    def _drain_escapes(self, sched, esc_count: int, esc_msize: int,
-                       esc_sp: int, esc_slots: int, esc_conds: int,
-                       arena_n: int, arena_nc: int):
-        """Fetch + materialize in one go (hand-over/terminal paths)."""
-        self._flush_backlog(self._fetch_escapes(
-            sched, esc_count, esc_msize, esc_sp, esc_slots, esc_conds,
-            arena_n, arena_nc))
-        return _reset_esc_compiled()(sched)
-
     def _sched_rows(self, sched) -> List[Tuple[Dict[str, np.ndarray],
                                                Dict[str, np.ndarray]]]:
         """Full rows still held by the device scheduler (sibling stack +
@@ -1166,6 +1202,7 @@ class _Frontier:
         account = global_state.environment.active_account
         used = state_np["storage_used"][lane]
         dirty = planes_np["storage_dirty"][lane]
+        sink_values = []  # integer-detector sink harvest (SSTORE/JUMPI)
         for slot in range(used.shape[0]):
             if not used[slot] or not dirty[slot]:
                 continue
@@ -1173,6 +1210,7 @@ class _Frontier:
             node = int(planes_np["storage_sym"][lane, slot])
             if node:
                 value = harena.to_term(node, ctx)
+                sink_values.append(value)
             else:
                 value = symbol_factory.BitVecVal(
                     int(words.to_ints(state_np["storage_vals"][lane, slot])),
@@ -1182,6 +1220,27 @@ class _Frontier:
         # path conditions
         for condition in self._cond_bools(planes_np, harena, lane):
             global_state.world_state.constraints.append(condition)
+        for position in range(int(planes_np["cond_count"][lane])):
+            signed = int(planes_np["conds"][lane, position])
+            sink_values.append(harena.to_term(abs(signed), ctx))
+
+        # the integer detector's SSTORE/JUMPI sink hooks fire on host
+        # execution; for instructions the device executed, harvest their
+        # overflow markers here with identical semantics
+        if sink_values:
+            from ..analysis.modules.integer import harvest_values
+
+            harvest_values(global_state, sink_values)
+
+        # last JUMP taken on device: the exceptions detector keys its
+        # dedup cache and source location on this annotation — without it
+        # every materialized INVALID after the first was cache-swallowed
+        last_jump = int(planes_np["last_jump"][lane]) \
+            if "last_jump" in planes_np else 0
+        if last_jump:
+            from ..analysis.modules.exceptions import LastJumpAnnotation
+
+            global_state.annotate(LastJumpAnnotation(last_jump))
 
         # gas accounting (device tracks the lower-bound model)
         gas_used = int(state_np["gas_used"][lane])
@@ -1241,15 +1300,29 @@ class _Frontier:
                     [rp[field] for _, rp in pending_rows])
         arrays["identity"] = np.asarray(
             [self.n_lanes, len(self.contexts)])
+        # tx stamp: n_lanes/n_contexts are env-fixed, so a wave saved during
+        # an EARLIER transaction would otherwise pass the identity check on
+        # resume and graft stale machine states onto fresh seeds (ADVICE r4)
+        arrays["tx_index"] = np.asarray(
+            [int(getattr(self.laser, "_current_tx_index", 0))])
         # V_HOST_TERM leaves index into per-context host_terms lists that
         # GROW after seeding (cold-SLOAD fault-ins); a resume that rebuilt
         # only the seed-time lists would resolve checkpointed nodes against
-        # wrong terms. Terms pickle exactly (smt/terms.py Term.__reduce__).
+        # wrong terms. Terms pickle exactly (smt/terms.py Term.__reduce__),
+        # but deep Select chains can exceed the default recursion limit —
+        # guard like support/checkpoint.py, and never let the periodic save
+        # crash the analysis it exists to protect (ADVICE r4).
         import pickle
+        import sys as sys_module
 
-        arrays["host_terms"] = np.frombuffer(
-            pickle.dumps([ctx.host_terms for ctx in self.contexts]),
-            dtype=np.uint8)
+        limit = sys_module.getrecursionlimit()
+        sys_module.setrecursionlimit(max(limit, 200_000))
+        try:
+            arrays["host_terms"] = np.frombuffer(
+                pickle.dumps([ctx.host_terms for ctx in self.contexts]),
+                dtype=np.uint8)
+        finally:
+            sys_module.setrecursionlimit(limit)
         import os
 
         tmp = f"{path}.tmp"
@@ -1270,6 +1343,13 @@ class _Frontier:
                 f"checkpoint identity mismatch: saved {n_lanes} lanes / "
                 f"{n_contexts} contexts, this frontier has {self.n_lanes} / "
                 f"{len(self.contexts)}")
+        if "tx_index" in data:
+            saved_tx = int(data["tx_index"][0])
+            current_tx = int(getattr(self.laser, "_current_tx_index", 0))
+            if saved_tx != current_tx:
+                raise ValueError(
+                    f"checkpoint is for transaction {saved_tx}, the "
+                    f"analysis is at transaction {current_tx}")
         if "host_terms" in data:
             import pickle
 
@@ -1418,17 +1498,7 @@ def execute_message_call_tpu(laser_evm, callee_address,
     lane_budget = int(os.environ.get("MYTHRIL_TPU_LANES", DEFAULT_LANES))
     frontier = _Frontier(laser_evm,
                          n_lanes=max(lane_budget, 2 * len(seeds)))
-    seeded = frontier.seed(seeds)
-    if seeded is None:
-        log.warning("--engine tpu: storage store-chain has a symbolic key; "
-                    "the device cannot soundly alias it — this transaction "
-                    "runs entirely on the host engine")
-        for template in seeds:
-            laser_evm.work_list.append(template)
-        laser_evm.exec()
-        return
-
-    state, planes = seeded
+    state, planes = frontier.seed(seeds)
     frontier.run(state, planes)
     log.info("frontier: %d forks, %d storage fault-ins, %d infeasible "
              "pruned, %d states materialized + %d deferred for the host "

@@ -48,10 +48,12 @@ SEARCHING, S_SAT, S_UNSAT = 0, 1, 2
 #: clause tile width for the scanned unit-propagation pass
 TILE = 2048
 
-#: default clause cap for device solving: above this the dense DPLL cannot win
-#: against the learning CDCL core anyway, and step time grows linearly with
-#: the tile count — refuse early and let the caller fall back loudly.
-DEFAULT_CLAUSE_CAP = 65_536
+#: default PER-DEVICE clause cap for device solving: step time grows
+#: linearly with the local tile count — refuse early and let the caller
+#: fall back loudly. The effective cap multiplies by the mesh size when the
+#: clause matrix shards across devices (a 256-bit multiply bit-blasts to
+#: ~1e5 clauses; one device now holds it, a mesh holds several).
+DEFAULT_CLAUSE_CAP = 1 << 18
 
 #: unassigned / true / false assignment codes
 _UNASSIGNED, _TRUE, _FALSE = 0, 1, 2
@@ -118,9 +120,17 @@ class _SolverState(NamedTuple):
     status: "jnp.ndarray"     # int8[P]
 
 
-def _step(state: _SolverState, lits, valid, order, forced_depth: int
-          ) -> _SolverState:
-    """One DPLL transition for every probe lane (pure; traced under jit)."""
+def _step(state: _SolverState, lits, valid, order, forced_depth: int,
+          axis_name: Optional[str] = None) -> _SolverState:
+    """One DPLL transition for every probe lane (pure; traced under jit).
+
+    With `axis_name`, the clause-tile axis is SHARDED across a device mesh
+    (shard_map): each device scans only its clause shard and the verdicts
+    combine with collectives — conflict flags by any-of, implied phases by
+    elementwise max (opposite-phase races are benign exactly as within one
+    device: the losing clause falsifies and conflicts next step). This is
+    the SURVEY §2.3 "tensor parallelism" analogue: the clause matrix is the
+    weight matrix, unit propagation the matmul, psum/pmax the reduction."""
     import jax
     import jax.numpy as jnp
 
@@ -158,6 +168,9 @@ def _step(state: _SolverState, lits, valid, order, forced_depth: int
     init = (jnp.zeros(n_probes, dtype=bool),
             jnp.zeros((n_probes, v1), dtype=jnp.int8))
     (conflict, implied), _ = jax.lax.scan(tile_body, init, (lits, valid))
+    if axis_name is not None:
+        conflict = jax.lax.pmax(conflict.astype(jnp.int8), axis_name) > 0
+        implied = jax.lax.pmax(implied, axis_name)
     implied = implied.at[:, 0].set(0)
     newly = (implied != 0) & (state.assign == _UNASSIGNED)       # [P, V1]
     has_units = jnp.any(newly, axis=-1)
@@ -257,6 +270,33 @@ def _get_runner(chunk: int, forced_depth: int):
     return jax.jit(run)
 
 
+@lru_cache(maxsize=16)
+def _get_sharded_runner(chunk: int, forced_depth: int, n_devices: int):
+    """Clause-matrix-sharded runner: lits/valid partition over the mesh's
+    "clauses" axis, solver state replicates, verdicts combine per step with
+    pmax collectives inside the fused loop."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:n_devices]), ("clauses",))
+
+    def run(state, lits, valid, order):
+        def body(_, st):
+            return _step(st, lits, valid, order, forced_depth,
+                         axis_name="clauses")
+
+        return jax.lax.fori_loop(0, chunk, body, state)
+
+    sharded = shard_map(
+        run, mesh=mesh,
+        in_specs=(_SolverState(*([P()] * 5)), P("clauses"), P("clauses"),
+                  P()),
+        out_specs=_SolverState(*([P()] * 5)),
+        check_rep=False)
+    return jax.jit(sharded), mesh
+
+
 def solve_cnf_device(clauses: List[List[int]], n_vars: int,
                      n_probes: int = 32, max_steps: int = 20_000,
                      chunk: int = 256, clause_cap: int = DEFAULT_CLAUSE_CAP
@@ -275,12 +315,37 @@ def solve_cnf_device(clauses: List[List[int]], n_vars: int,
     for clause in clauses:
         if not clause:
             return UNSAT, None
-    if len(clauses) > clause_cap:
+
+    # clause-matrix sharding across the mesh (SURVEY §2.3 TP analogue):
+    # the cap scales with the device count — each device scans only its
+    # tile shard per step. Same gating as the frontier's lane sharding:
+    # MYTHRIL_TPU_SHARD=1 forces on, =0 off, default on for real
+    # accelerator meshes only.
+    import os
+
+    import jax
+
+    devices = jax.devices()
+    flag = os.environ.get("MYTHRIL_TPU_SHARD")
+    n_devices = 1
+    if len(devices) > 1 and flag != "0" \
+            and (flag == "1" or devices[0].platform != "cpu"):
+        n_devices = len(devices)
+    if len(clauses) > clause_cap * n_devices:
         return UNKNOWN, None
 
     problem = _build_problem(clauses, n_vars)
     forced_depth = max(0, int(np.log2(max(1, n_probes))))
-    runner = _get_runner(chunk, forced_depth)
+    if n_devices > 1 and problem.lits.shape[0] % n_devices == 0 \
+            and problem.lits.shape[0] >= n_devices:
+        runner, mesh = _get_sharded_runner(chunk, forced_depth, n_devices)
+    else:
+        if len(clauses) > clause_cap:
+            # the mesh-scaled cap only holds when the tiles actually shard;
+            # refuse loudly rather than run n_devices x the per-device
+            # budget on one device
+            return UNKNOWN, None
+        runner = _get_runner(chunk, forced_depth)
 
     v1 = problem.order.shape[0]
     lits = jnp.asarray(problem.lits)

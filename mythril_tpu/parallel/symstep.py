@@ -95,6 +95,9 @@ class SymPlanes(NamedTuple):
     fork_cond: jnp.ndarray     # int32[B] node id pending at a FORKING lane
     symbolic_env: jnp.ndarray  # bool[B] env/calldata are symbolic
     ctx_id: jnp.ndarray        # int32[B] seeding-context index (rides forks)
+    last_jump: jnp.ndarray     # int32[B] byte address of the last JUMP taken
+    #                            (0 = none) — materializes as the exceptions
+    #                            detector's LastJumpAnnotation source hint
 
     @classmethod
     def empty(cls, batch: int, stack_slots: int, mem_bytes: int,
@@ -110,6 +113,7 @@ class SymPlanes(NamedTuple):
             fork_cond=jnp.zeros(batch, dtype=I32),
             symbolic_env=jnp.ones(batch, dtype=bool),
             ctx_id=jnp.full(batch, -1, dtype=I32),
+            last_jump=jnp.zeros(batch, dtype=I32),
         )
 
 
@@ -385,10 +389,12 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
     # SSTORE of a symbolic value with concrete key: store node directly
     sstore_sym_val = advanced & sstore_mask & (sym1 == 0) & (sym2 != 0)
 
-    # result nodes for computations
+    # result nodes for computations; imm2 records the instruction's byte
+    # address — host-side conversion reconstructs the integer detector's
+    # OverUnderflowAnnotation (operator + site) from it
     arena, result_node, ovf_r = A.alloc_rows(
         arena, sym_compute, op, node_a, node_b, jnp.zeros_like(node_a),
-        jnp.zeros_like(node_a), jnp.zeros_like(node_a))
+        jnp.zeros_like(node_a), state.pc.astype(I32))
 
     # env var nodes
     env_alloc = advanced & (env_var_op | cdl_var)
@@ -465,10 +471,11 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
         jnp.where((state.status == RUNNING) & sload_cold, 0,
                   new_planes.fork_cond))
 
-    new_planes = new_planes._replace(mem_sym=mem_sym,
-                                     storage_sym=storage_sym,
-                                     storage_dirty=storage_dirty,
-                                     fork_cond=fork_cond)
+    new_planes = new_planes._replace(
+        mem_sym=mem_sym, storage_sym=storage_sym,
+        storage_dirty=storage_dirty, fork_cond=fork_cond,
+        last_jump=jnp.where(advanced & is_op("JUMP"), state.pc,
+                            new_planes.last_jump).astype(I32))
 
     # ---- escape buffering (before forking: freed lanes are claimable) ---------------
     # Halting / host-owned lanes move their row into the escape buffer and
@@ -602,7 +609,7 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
         stack_top=sched.stack_top + n_push,
         esc_state=esc_state, esc_planes=esc_planes,
         esc_count=esc_used + n_spill,
-        pushes=sched.pushes + (n_push + n_spill).astype(jnp.int64),
+        pushes=sched.pushes + n_push.astype(jnp.int64),
         forks=sched.forks + jnp.sum(act).astype(jnp.int64))
 
     # 4. forker divergence: take the jump (or die on an invalid dest)

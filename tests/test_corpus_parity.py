@@ -1,0 +1,48 @@
+"""Findings-parity lock over the committed corpus measurements
+(VERDICT r4 next-round #2 done-criterion: per-contract corpus_tpu SWC sets
+must be a superset of corpus_host at equal budget).
+
+tools/measure_corpus.py writes corpus_{engine}.json from real equal-budget
+sweeps (the tpu sweep on the chip, the host sweep on CPU); this test locks
+the committed results so a findings regression cannot land silently. The
+sweeps themselves are too slow for CI (19 contracts x 2 engines x 90 s) —
+re-run the tool after engine changes and commit the refreshed jsons.
+"""
+
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(engine):
+    path = os.path.join(REPO, f"corpus_{engine}.json")
+    if not os.path.exists(path):
+        pytest.skip(f"{path} not measured")
+    with open(path) as handle:
+        return json.load(handle)
+
+
+def test_tpu_swc_sets_cover_host():
+    host = _load("host")
+    tpu = _load("tpu")
+    assert host["budget_s"] == tpu["budget_s"], \
+        "corpus sweeps measured at different budgets are not comparable"
+    missing = {}
+    for name, host_result in host["contracts"].items():
+        host_swc = set(host_result.get("swc") or [])
+        tpu_swc = set(tpu["contracts"].get(name, {}).get("swc") or [])
+        if not host_swc <= tpu_swc:
+            missing[name] = sorted(host_swc - tpu_swc)
+    assert not missing, \
+        f"tpu engine misses host findings at equal budget: {missing}"
+
+
+def test_tpu_total_findings_at_least_host():
+    host = _load("host")
+    tpu = _load("tpu")
+    assert tpu["total_swc_findings"] >= host["total_swc_findings"] * 0.9, (
+        f"tpu total findings collapsed: {tpu['total_swc_findings']} vs "
+        f"host {host['total_swc_findings']}")

@@ -163,6 +163,35 @@ def test_runtime_code_engages_device():
     assert total_faults > 0, f"no storage fault-ins: {frontier_lines}"
 
 
+def test_symbolic_storage_key_stays_on_device():
+    """A tx-1 SSTORE with a SYMBOLIC key (`mapping[msg.sender]`-style —
+    every token contract) must NOT force tx 2 into a whole-transaction host
+    fallback: the chain walk stops at the symbolic-key store and cold
+    SLOADs fault in Select(chain, key) (frontier._storage_entries)."""
+    contract = {
+        # tx1: storage[caller] = 1 (symbolic key), storage[3] = 7 (concrete)
+        "setup()": "PUSH1 0x01\nCALLER\nSSTORE\n"
+                   "PUSH1 0x07\nPUSH1 0x03\nSSTORE\nSTOP",
+        # tx2: a concrete-key read (possibly shadowed by the symbolic store)
+        # guards a selfdestruct
+        "drain()": "PUSH1 0x03\nSLOAD\nPUSH1 0x07\nEQ\nPUSH @kill\nJUMPI\n"
+                   "STOP\nkill:\nJUMPDEST\nCALLER\nSELFDESTRUCT",
+    }
+    host = analyze_with_engine(contract, ["AccidentallyKillable"], 2, "host")
+    handler, logger, records = _capture_frontier_log()
+    try:
+        tpu = analyze_with_engine(contract, ["AccidentallyKillable"], 2,
+                                  "tpu")
+    finally:
+        logger.removeHandler(handler)
+    assert sorted(i.swc_id for i in tpu) == sorted(
+        i.swc_id for i in host) == ["106"]
+    assert not any("runs entirely on the host" in m for m in records), \
+        f"symbolic-key storage forced a host fallback: {records}"
+    # both transactions' frontiers ran (one log line per device phase)
+    assert len([m for m in records if " forks" in m]) >= 2, records
+
+
 def test_frontier_forks_on_device():
     """The exploration must demonstrably run on device: symbolic JUMPI forks
     are serviced by the frontier, not the host engine."""

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""BASELINE.md headline-config measurement (VERDICT r4 #7).
+
+The reference README's flagship invocations are
+  myth analyze solidity_examples/killbilly.sol -t 3
+  myth analyze solidity_examples/BECToken.sol -t 4 -m IntegerArithmetics
+(/root/reference/solidity_examples/). This environment has no solc, so the
+contracts are VENDORED here as hand-assembled semantic equivalents built
+with the in-repo assembler (frontends/asm.py) — same storage layout, same
+require structure, same keccak-keyed mappings, same vulnerable paths:
+
+- killbilly: is_killable @ slot0, approved_killers @ mapping slot1;
+  killerize(address) -> activatekillability() -> commencekilling()
+  selfdestructs: the SWC-106 3-transaction chain.
+- BECToken batchTransfer: cnt * _value overflows (CVE-2018-10299) before
+  the balance check, so a huge _value passes require(balances >= amount):
+  the SWC-101 the reference headline finds with -m IntegerArithmetics.
+
+Usage: python tools/measure_headline.py [--engine host|tpu] [--budget 300]
+Writes headline_{engine}.json at the repo root.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: mapping access: key = keccak256(pad32(k) ++ pad32(slot))
+def _mapping_load(key_src: str, slot: int) -> str:
+    return (f"{key_src}\nPUSH1 0x00\nMSTORE\n"
+            f"PUSH1 {hex(slot)}\nPUSH1 0x20\nMSTORE\n"
+            "PUSH1 0x40\nPUSH1 0x00\nSHA3")
+
+
+KILLBILLY = {
+    # killerize(address addr): approved_killers[addr] = true
+    "killerize(address)":
+        "PUSH1 0x01\n"                       # value true
+        + _mapping_load("PUSH1 0x04\nCALLDATALOAD", 1) + "\n"
+        "SSTORE\nSTOP",
+    # activatekillability(): require(approved_killers[msg.sender]);
+    # is_killable = true
+    "activatekillability()":
+        _mapping_load("CALLER", 1) + "\n"
+        "SLOAD\nPUSH @ok\nJUMPI\n"
+        "PUSH1 0x00\nPUSH1 0x00\nREVERT\n"
+        "ok:\nJUMPDEST\nPUSH1 0x01\nPUSH1 0x00\nSSTORE\nSTOP",
+    # commencekilling(): require(is_killable); selfdestruct(msg.sender)
+    "commencekilling()":
+        "PUSH1 0x00\nSLOAD\nPUSH @kill\nJUMPI\n"
+        "PUSH1 0x00\nPUSH1 0x00\nREVERT\n"
+        "kill:\nJUMPDEST\nCALLER\nSELFDESTRUCT",
+}
+
+#: balances mapping at slot 0 (the fields the CVE path touches)
+BECTOKEN = {
+    # transfer(address to, uint256 value): balances[caller] -= v (checked),
+    # balances[to] += v — the benign baseline function
+    "transfer(address,uint256)":
+        "PUSH1 0x24\nCALLDATALOAD\n"                  # v
+        + _mapping_load("CALLER", 0) + "\n"           # key(caller)
+        "DUP1\nSLOAD\n"                               # v key bal
+        "DUP3\nDUP2\nLT\nPUSH @bail\nJUMPI\n"         # bal < v -> bail
+        "SUB\nSWAP1\nSSTORE\n"                        # balances[caller]=bal-v
+        "PUSH1 0x24\nCALLDATALOAD\n"
+        + _mapping_load("PUSH1 0x04\nCALLDATALOAD", 0) + "\n"
+        "DUP1\nSLOAD\n"                               # v key bal2
+        "DUP3\nADD\nSWAP1\nSSTORE\nSTOP\n"            # balances[to]=bal2+v
+        "bail:\nJUMPDEST\nPUSH1 0x00\nPUSH1 0x00\nREVERT",
+    # batchTransfer(address[] receivers, uint256 value):
+    #   cnt = receivers.length; amount = cnt * value   <-- SWC-101 overflow
+    #   require(0 < cnt <= 20); require(value > 0 && balances[caller] >= amount)
+    #   balances[caller] -= amount; balances[receivers[0]] += value (loop body
+    #   representative: the overflow is upstream of the loop)
+    "batchTransfer(address[],uint256)":
+        "PUSH1 0x04\nCALLDATALOAD\nPUSH1 0x04\nADD\nCALLDATALOAD\n"  # cnt
+        "DUP1\nISZERO\nPUSH @bail\nJUMPI\n"           # cnt == 0 -> bail
+        "DUP1\nPUSH1 0x14\nLT\nPUSH @bail\nJUMPI\n"   # 20 < cnt -> bail
+        "PUSH1 0x24\nCALLDATALOAD\n"                  # cnt value
+        "DUP1\nISZERO\nPUSH @bail\nJUMPI\n"           # value == 0 -> bail
+        "MUL\n"                                       # amount = cnt*value
+        + _mapping_load("CALLER", 0) + "\n"           # amount key
+        "DUP1\nSLOAD\n"                               # amount key bal
+        "DUP3\nDUP2\nLT\nPUSH @bail\nJUMPI\n"         # bal < amount -> bail
+        "SUB\nSWAP1\nSSTORE\n"                        # balances[caller] -=
+        "PUSH1 0x24\nCALLDATALOAD\n"                  # value
+        + _mapping_load("PUSH1 0x24\nPUSH1 0x04\nCALLDATALOAD\nADD\n"
+                        "CALLDATALOAD", 0) + "\n"     # key(receivers[0])
+        "DUP1\nSLOAD\nDUP3\nADD\nSWAP1\nSSTORE\n"     # balances[r0] += value
+        "PUSH1 0x01\nPUSH1 0x00\nMSTORE\nPUSH1 0x20\nPUSH1 0x00\nRETURN\n"
+        "bail:\nJUMPDEST\nPUSH1 0x00\nPUSH1 0x00\nREVERT",
+}
+
+
+def run(name, runtime_src, tx_count, modules, engine, budget):
+    from mythril_tpu.analysis.security import (fire_lasers,
+                                               reset_callback_modules)
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.frontends.asm import (assemble, creation_wrapper,
+                                           dispatcher)
+    from mythril_tpu.smt.solver.solver import reset_solver_backend
+
+    reset_callback_modules()
+    reset_solver_backend()
+    creation = creation_wrapper(assemble(dispatcher(runtime_src)))
+    start = time.perf_counter()
+    wrapper = SymExecWrapper(
+        creation.hex(), address=None, strategy="bfs", max_depth=128,
+        execution_timeout=budget, create_timeout=60,
+        transaction_count=tx_count, compulsory_statespace=False,
+        modules=modules, engine=engine)
+    issues = fire_lasers(wrapper, white_list=modules)
+    elapsed = time.perf_counter() - start
+    laser = wrapper.laser
+    states = laser.executed_nodes + getattr(laser, "frontier_lane_steps", 0)
+    result = {
+        "states": states,
+        "elapsed_s": round(elapsed, 2),
+        "states_per_sec": round(states / max(elapsed, 1e-9), 1),
+        "swc": sorted({i.swc_id for i in issues}),
+        "n_issues": len(issues),
+        "forks_on_device": getattr(laser, "frontier_forks", 0),
+    }
+    print(json.dumps({"contract": name, "engine": engine, **result}),
+          flush=True)
+    return result
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--engine", default="host", choices=["host", "tpu"])
+    parser.add_argument("--budget", type=int, default=300)
+    args = parser.parse_args()
+    results = {
+        # reference README flagship: myth analyze killbilly.sol -t 3
+        "killbilly_t3": run("killbilly_t3", KILLBILLY, 3,
+                            ["AccidentallyKillable"], args.engine,
+                            args.budget),
+        # myth analyze BECToken.sol -t 4 -m IntegerArithmetics (the -t 4 of
+        # the reference bounds the search; the overflow fires in tx 1)
+        "bectoken_t4_integer": run("bectoken_t4_integer", BECTOKEN, 4,
+                                   ["IntegerArithmetics"], args.engine,
+                                   args.budget),
+    }
+    out = os.path.join(REPO, f"headline_{args.engine}.json")
+    with open(out, "w") as handle:
+        json.dump({"engine": args.engine, "budget_s": args.budget,
+                   "results": results}, handle, indent=1)
+    print(json.dumps({"written": out}))
+
+
+if __name__ == "__main__":
+    main()
