@@ -426,14 +426,42 @@ class Instruction:
 
     @StateTransition()
     def codesize_(self, s: GlobalState) -> List[GlobalState]:
-        s.mstate.stack.append(symbol_factory.BitVecVal(
-            len(s.environment.code.raw_code), 256))
+        no_of_bytes = len(s.environment.code.raw_code)
+        transaction = s.current_transaction
+        if isinstance(transaction, ContractCreationTransaction):
+            # constructor ARGUMENTS are appended past the creation code;
+            # reserve space for 16 32-byte args and pin the symbolic
+            # calldata's size to it (reference instructions.py:983-1004)
+            calldata = s.environment.calldata
+            if isinstance(calldata, ConcreteCalldata):
+                no_of_bytes += calldata.size
+            else:
+                no_of_bytes += 0x200
+                s.world_state.constraints.append(
+                    calldata.calldatasize ==
+                    symbol_factory.BitVecVal(no_of_bytes, 256))
+        s.mstate.stack.append(symbol_factory.BitVecVal(no_of_bytes, 256))
         return [s]
 
     @StateTransition()
     def codecopy_(self, s: GlobalState) -> List[GlobalState]:
         mem_offset, code_offset, size = s.mstate.pop(3)
         code = s.environment.code.raw_code
+        if isinstance(s.current_transaction, ContractCreationTransaction) \
+                and code_offset.raw.is_const \
+                and code_offset.value >= len(code):
+            # creation code past its end = the constructor arguments,
+            # served from the (symbolic) creation calldata
+            # (reference instructions.py:1078-1105)
+            arg_offset = symbol_factory.BitVecVal(
+                code_offset.value - len(code), 256)
+            calldata = s.environment.calldata
+
+            def fetch(i: int) -> BitVec:
+                return calldata[arg_offset + i]
+
+            self._copy_to_memory(s, mem_offset, size, fetch, "codecopy")
+            return [s]
         fetch = self._code_fetcher(s, code, code_offset, "codecopy")
         self._copy_to_memory(s, mem_offset, size, fetch, "codecopy")
         return [s]
@@ -595,6 +623,11 @@ class Instruction:
         instruction = s.get_current_instruction()
         width = int(self.op_code[4:])
         argument = instruction.get("argument", "0x0")
+        if isinstance(argument, BitVec):
+            # symbolic immediate (immutable deployed from a constructor arg)
+            s.mstate.stack.append(ZeroExt(256 - argument.size(), argument)
+                                  if argument.size() < 256 else argument)
+            return [s]
         if isinstance(argument, str):
             value = int(argument, 16) if len(argument) > 2 else 0  # "0x": no immediate
         else:
@@ -700,6 +733,7 @@ class Instruction:
         if not negated.is_false:
             negative_state = copy(s)
             negative_state.mstate.pc += 1
+            negative_state.mstate.depth += 1  # depth = branches taken
             negative_state.world_state.constraints.append(negated)
             states.append(negative_state)
 
@@ -715,6 +749,7 @@ class Instruction:
                     and s.environment.code.instruction_list[index].op_code == "JUMPDEST"):
                 positive_state = copy(s)
                 positive_state.mstate.pc = index
+                positive_state.mstate.depth += 1  # depth = branches taken
                 positive_state.world_state.constraints.append(positive)
                 states.append(positive_state)
         return states
@@ -809,6 +844,32 @@ class Instruction:
         s.mstate.pc += 1
         return [s]
 
+    @staticmethod
+    def _write_symbolic_returndata(s: GlobalState, memory_out_offset,
+                                   memory_out_size) -> None:
+        """An un-executable call still RETURNS unknown data: fresh symbolic
+        bytes land in the memory-out window (when concrete) and
+        last_return_data gets a symbolic size — without this,
+        RETURNDATASIZE reads 0 after every unresolved call and solc's
+        `returndatasize < 32` guards revert every path (reference
+        instructions.py:1971 _write_symbolic_returndata)."""
+        try:
+            offset = get_concrete_int(memory_out_offset)
+            size = get_concrete_int(memory_out_size)
+        except TypeError:
+            return
+        return_bytes = [s.new_bitvec(f"call_output_var({offset + i})_"
+                                     f"{s.mstate.pc}", 8)
+                        for i in range(size)]
+        return_data_size = s.new_bitvec("returndatasize", 256)
+        if size:
+            s.mstate.mem_extend(offset, size)
+            for i in range(size):
+                s.mstate.memory[offset + i] = If(
+                    symbol_factory.BitVecVal(i, 256) <= return_data_size,
+                    return_bytes[i], s.mstate.memory[offset + i])
+        s.last_return_data = ReturnData(return_bytes, return_data_size)
+
     def _call_family(self, s: GlobalState, with_value: bool,
                      static: bool = False, delegate: bool = False,
                      callcode: bool = False) -> List[GlobalState]:
@@ -855,6 +916,8 @@ class Instruction:
                                                         256)
                 transfer_ether(s, s.environment.address, receiver, value)
             s.world_state.constraints.append(Or(retval == 1, retval == 0))
+            self._write_symbolic_returndata(s, memory_out_offset,
+                                            memory_out_size)
             s.mstate.pc += 1
             return [s]
 
@@ -864,6 +927,8 @@ class Instruction:
             if with_value:
                 transfer_ether(s, s.environment.address, callee_account.address, value)
             s.mstate.stack.append(symbol_factory.BitVecVal(1, 256))
+            self._write_symbolic_returndata(s, memory_out_offset,
+                                            memory_out_size)
             s.mstate.pc += 1
             return [s]
 

@@ -453,8 +453,11 @@ class LaserEVM:
                     end_signal, transaction, return_global_state)
 
         self.executed_nodes += 1
-        for state in new_global_states:
-            state.mstate.depth += 1
+        # depth counts JUMPI BRANCHES, not instructions (reference
+        # increments only in jumpi_, instructions.py:1640,1665): a
+        # per-instruction count made max_depth=128 truncate any
+        # straight-line run past 128 instructions — every real solc
+        # constructor — silently gutting coverage
         return new_global_states, op_code
 
     def _end_message_call(self, end_signal: TransactionEndSignal,

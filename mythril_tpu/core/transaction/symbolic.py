@@ -131,7 +131,10 @@ def execute_contract_creation(laser_evm, contract_initialization_code: str,
             code=Disassembly(contract_initialization_code),
             caller=ACTORS.creator,
             contract_name=contract_name,
-            call_data=[],
+            # symbolic, not []: constructor ARGUMENTS live past the end of
+            # the creation code and read through codesize/codecopy
+            # (reference transaction_models.py:233 models them exactly so)
+            call_data=SymbolicCalldata(next_transaction_id),
             call_value=symbol_factory.BitVecSym(f"call_value{next_transaction_id}", 256),
         )
         _setup_global_state_for_execution(laser_evm, transaction)

@@ -190,19 +190,58 @@ class ContractCreationTransaction(BaseTransaction):
             revert: bool = False):
         from ...frontends.disassembler import Disassembly
 
-        if not all(isinstance(item, int) or (isinstance(item, BitVec) and item.raw.is_const)
-                   for item in (return_data.return_data if return_data else [])):
-            self.return_data = None
-            raise TransactionEndSignal(global_state, revert)
         if return_data is None or not return_data.return_data:
             self.return_data = None
             raise TransactionEndSignal(global_state, revert)
-        contract_code = bytes(item if isinstance(item, int) else item.value
-                              for item in return_data.return_data)
-        global_state.environment.active_account.code = Disassembly(contract_code.hex())
+        # SYMBOLIC bytes in the returned runtime (immutables initialized
+        # from constructor arguments) deploy as symbolic PUSH immediates:
+        # the code skeleton disassembles from a zero-patched image and any
+        # PUSH whose immediate window covers a symbolic position carries
+        # the original byte expressions (the reference keeps the whole
+        # bytecode as an expression tuple, transaction_models.py:73-75 +
+        # asm.disassemble; this is the same capability scoped to push
+        # arguments, where immutables land)
+        raw = []
+        symbolic_positions = {}
+        for position, item in enumerate(return_data.return_data):
+            if isinstance(item, int):
+                raw.append(item)
+            elif isinstance(item, BitVec) and item.raw.is_const:
+                raw.append(item.value)
+            else:
+                raw.append(0)
+                symbolic_positions[position] = item
+        disassembly = Disassembly(bytes(raw).hex())
+        if symbolic_positions:
+            self._patch_symbolic_immediates(disassembly, raw,
+                                            symbolic_positions)
+        global_state.environment.active_account.code = disassembly
         self.return_data = ReturnAddress(global_state.environment.active_account.address)
         assert global_state.environment.active_account.code.instruction_list != []
         raise TransactionEndSignal(global_state, revert)
+
+    @staticmethod
+    def _patch_symbolic_immediates(disassembly, raw, symbolic_positions):
+        from ...smt import Concat, symbol_factory
+
+        for instruction in disassembly.instruction_list:
+            op_code = instruction.op_code
+            if not op_code.startswith("PUSH") or op_code == "PUSH0":
+                continue
+            width = int(op_code[4:])
+            start = instruction.address + 1
+            window = range(start, start + width)
+            if not any(p in symbolic_positions for p in window):
+                continue
+            parts = []
+            for p in window:
+                expression = symbolic_positions.get(p)
+                if expression is None:
+                    byte = raw[p] if p < len(raw) else 0
+                    expression = symbol_factory.BitVecVal(byte, 8)
+                parts.append(expression)
+            instruction.argument = (Concat(*parts) if len(parts) > 1
+                                    else parts[0])
 
 
 class ReturnAddress:

@@ -349,7 +349,7 @@ class HostArena:
                     # reconstructs the identical marker here (site address
                     # rides in imm2) so sinks downstream harvest it
                     self._attach_overflow_annotation(
-                        op, result, ca, cb, int(self.imm2[node_id]))
+                        op, result, ca, cb, int(self.imm2[node_id]), ctx)
             elif op in _SHIFTS:
                 # EVM shift operand order: (shift, value)
                 result = bv(T.bv_binop(_SHIFTS[op], cb.raw, ca.raw))
@@ -387,7 +387,7 @@ class HostArena:
 
                 result, _ = exponent_function_manager.create_condition(ca, cb)
                 self._attach_overflow_annotation(
-                    op, result, ca, cb, int(self.imm2[node_id]))
+                    op, result, ca, cb, int(self.imm2[node_id]), ctx)
             elif op == 0x0F:  # internal: ite(cond=a, then=b, else=c)
                 cc = self._convert(int(self.c[node_id]), ctx)
                 cond = T.bool_not(T.bv_cmp("eq", ca.raw, T.bv_const(0, 256)))
@@ -398,8 +398,8 @@ class HostArena:
         return result
 
     @staticmethod
-    def _attach_overflow_annotation(op: int, result, ca, cb,
-                                    address: int) -> None:
+    def _attach_overflow_annotation(op: int, result, ca, cb, address: int,
+                                    ctx) -> None:
         """Device-executed ADD/SUB/MUL: attach the integer detector's
         OverUnderflowAnnotation exactly as the host pre-hook would
         (analysis/modules/integer.py _handle_add/_handle_sub/_handle_mul).

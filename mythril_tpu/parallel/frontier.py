@@ -1246,6 +1246,8 @@ class _Frontier:
         gas_used = int(state_np["gas_used"][lane])
         mstate.min_gas_used += gas_used
         mstate.max_gas_used += gas_used
+        # depth parity: each device-appended condition is one JUMPI branch
+        mstate.depth += int(planes_np["cond_count"][lane])
 
         self.materialized += 1
         if getattr(self.laser, "requires_statespace", False) and \
