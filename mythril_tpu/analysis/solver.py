@@ -108,6 +108,16 @@ def _get_concrete_transaction(model, transaction: BaseTransaction) -> Dict:
     """Concretize one transaction under the model (reference solver.py:187)."""
     if isinstance(transaction, ContractCreationTransaction):
         code = transaction.code.bytecode if transaction.code else ""
+        # constructor ARGUMENTS follow the code (reference solver.py:195-204
+        # appends call_data.concrete(model)); the symbolic creation calldata
+        # models args at offset 0
+        try:
+            arg_bytes = transaction.call_data.concrete(model)
+        except Exception:
+            arg_bytes = []
+        args_hex = "".join("{:02x}".format(b if isinstance(b, int) else 0)
+                           for b in (arg_bytes or [])[:0x200])
+        code = code + args_hex
         return {
             "address": "",
             "input": "0x" + code,
@@ -150,6 +160,12 @@ def _set_minimisation_constraints(transaction_sequence, constraints, minimize,
     """Bound balances, prefer short calldata and small call values
     (reference solver.py:219)."""
     for transaction in transaction_sequence:
+        if isinstance(transaction, ContractCreationTransaction):
+            # creation calldatasize is PINNED to code + 0x200 arg space by
+            # codesize_ (instructions.py) — bounding it to max_size would
+            # make every witness query unsat for creation code > ~4.5 KB
+            minimize.append(transaction.call_value)
+            continue
         # bound calldata size so witnesses stay printable
         constraints.append(
             ULE(transaction.call_data.calldatasize,

@@ -415,6 +415,14 @@ class Instruction:
     @StateTransition()
     def calldatacopy_(self, s: GlobalState) -> List[GlobalState]:
         mem_offset, data_offset, size = s.mstate.pop(3)
+        if isinstance(s.current_transaction, ContractCreationTransaction):
+            # creation "calldata" is code||args, but the symbolic creation
+            # calldata models ONLY the args (served through codecopy past
+            # the code end) — copying from offset 0 here would conflate
+            # code bytes with arg bytes. The reference no-ops CALLDATACOPY
+            # in creation txs (instructions.py:891-893).
+            log.debug("CALLDATACOPY during contract creation: no-op")
+            return [s]
         calldata = s.environment.calldata
         if data_offset.raw.is_const:
             base = data_offset.value

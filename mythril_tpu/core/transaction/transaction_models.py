@@ -213,8 +213,14 @@ class ContractCreationTransaction(BaseTransaction):
                 symbolic_positions[position] = item
         disassembly = Disassembly(bytes(raw).hex())
         if symbolic_positions:
-            self._patch_symbolic_immediates(disassembly, raw,
-                                            symbolic_positions)
+            unpatched = self._patch_symbolic_immediates(
+                disassembly, raw, symbolic_positions)
+            if unpatched:
+                # a symbolic byte at an OPCODE position would deploy a
+                # different instruction stream than any real deployment —
+                # refuse, as the pre-round-5 code did for any symbolic byte
+                self.return_data = None
+                raise TransactionEndSignal(global_state, revert)
         global_state.environment.active_account.code = disassembly
         self.return_data = ReturnAddress(global_state.environment.active_account.address)
         assert global_state.environment.active_account.code.instruction_list != []
@@ -222,8 +228,12 @@ class ContractCreationTransaction(BaseTransaction):
 
     @staticmethod
     def _patch_symbolic_immediates(disassembly, raw, symbolic_positions):
+        """Returns the set of symbolic positions NOT covered by any PUSH
+        immediate window (i.e. symbolic opcodes) — the caller refuses the
+        deployment when it is non-empty."""
         from ...smt import Concat, symbol_factory
 
+        covered = set()
         for instruction in disassembly.instruction_list:
             op_code = instruction.op_code
             if not op_code.startswith("PUSH") or op_code == "PUSH0":
@@ -239,9 +249,12 @@ class ContractCreationTransaction(BaseTransaction):
                 if expression is None:
                     byte = raw[p] if p < len(raw) else 0
                     expression = symbol_factory.BitVecVal(byte, 8)
+                else:
+                    covered.add(p)
                 parts.append(expression)
             instruction.argument = (Concat(*parts) if len(parts) > 1
                                     else parts[0])
+        return set(symbolic_positions) - covered
 
 
 class ReturnAddress:

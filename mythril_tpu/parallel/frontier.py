@@ -119,7 +119,7 @@ def _summary(state, planes, arena, sched):
 
 #: _drain_light int32-section field layout: (name, per-row element count fn)
 _DRAIN_I32_FIELDS = ("pc", "sp", "msize", "code_len", "cond_count",
-                     "ctx_id", "last_jump")
+                     "ctx_id", "last_jump", "branches")
 
 
 def _pack_rows(state_like, planes_like, index, mem_b: int, sp_b: int,
@@ -140,6 +140,7 @@ def _pack_rows(state_like, planes_like, index, mem_b: int, sp_b: int,
     i32 = jnp.concatenate([
         s.pc[index], s.sp[index], s.msize[index], s.code_len[index],
         p.cond_count[index], p.ctx_id[index], p.last_jump[index],
+        p.branches[index],
         b32(s.stack[index][:, :sp_b]).reshape(-1),
         b32(s.storage_keys[index][:, :st_b]).reshape(-1),
         b32(s.storage_vals[index][:, :st_b]).reshape(-1),
@@ -189,7 +190,7 @@ def _drain_unpack(i32, u8, gas, bucket: int, mem_b: int, sp_b: int,
     rows_planes = {}
     for field in _DRAIN_I32_FIELDS:
         target = rows_planes if field in ("cond_count", "ctx_id",
-                                          "last_jump") \
+                                          "last_jump", "branches") \
             else rows_state
         target[field] = cut(bucket)
     rows_state["stack"] = cut(bucket * sp_b * limbs,
@@ -1246,8 +1247,12 @@ class _Frontier:
         gas_used = int(state_np["gas_used"][lane])
         mstate.min_gas_used += gas_used
         mstate.max_gas_used += gas_used
-        # depth parity: each device-appended condition is one JUMPI branch
-        mstate.depth += int(planes_np["cond_count"][lane])
+        # depth parity: the device counts every JUMPI branch it took
+        # (concrete-condition branches included), exactly like host jumpi_
+        if "branches" in planes_np:
+            mstate.depth += int(planes_np["branches"][lane])
+        else:
+            mstate.depth += int(planes_np["cond_count"][lane])
 
         self.materialized += 1
         if getattr(self.laser, "requires_statespace", False) and \

@@ -95,6 +95,10 @@ class SymPlanes(NamedTuple):
     fork_cond: jnp.ndarray     # int32[B] node id pending at a FORKING lane
     symbolic_env: jnp.ndarray  # bool[B] env/calldata are symbolic
     ctx_id: jnp.ndarray        # int32[B] seeding-context index (rides forks)
+    branches: jnp.ndarray      # int32[B] JUMPI branches taken (host depth
+    #                            parity: the host increments mstate.depth
+    #                            per surviving JUMPI branch, concrete or
+    #                            symbolic — materialization adds this)
     last_jump: jnp.ndarray     # int32[B] byte address of the last JUMP taken
     #                            (0 = none) — materializes as the exceptions
     #                            detector's LastJumpAnnotation source hint
@@ -113,6 +117,7 @@ class SymPlanes(NamedTuple):
             fork_cond=jnp.zeros(batch, dtype=I32),
             symbolic_env=jnp.ones(batch, dtype=bool),
             ctx_id=jnp.full(batch, -1, dtype=I32),
+            branches=jnp.zeros(batch, dtype=I32),
             last_jump=jnp.zeros(batch, dtype=I32),
         )
 
@@ -474,6 +479,13 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
     new_planes = new_planes._replace(
         mem_sym=mem_sym, storage_sym=storage_sym,
         storage_dirty=storage_dirty, fork_cond=fork_cond,
+        # a CONCRETE-condition JUMPI executes on device (plumbing) and
+        # counts one branch, matching host jumpi_'s depth increment;
+        # symbolic forks count via the fork block below (both sides
+        # inherit the forker's counter + 1)
+        branches=jnp.where(advanced & is_op("JUMPI"),
+                           new_planes.branches + 1,
+                           new_planes.branches).astype(I32),
         last_jump=jnp.where(advanced & is_op("JUMP"), state.pc,
                             new_planes.last_jump).astype(I32))
 
@@ -557,12 +569,15 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
         jnp.where(act, lane, batch), count].set(sym2, mode="drop")
     ccount_fork = jnp.where(act, planes.cond_count + 1,
                             new_planes.cond_count)
+    branches_fork = jnp.where(act, planes.branches + 1,
+                              new_planes.branches).astype(I32)
     j_slots = jnp.arange(slots)
     cleared = act[:, None] & (j_slots[None, :] >= sp_fork[:, None])
     ssym_fork = jnp.where(cleared, 0, new_planes.stack_sym)
     state_a = new_state._replace(sp=sp_fork, gas_used=gas_fork)
     planes_a = new_planes._replace(conds=conds_fork, cond_count=ccount_fork,
-                                   stack_sym=ssym_fork)
+                                   stack_sym=ssym_fork,
+                                   branches=branches_fork)
 
     # 2. the fall-through SIBLING rows: pc+1, flipped condition sign,
     #    RUNNING, no wait marker

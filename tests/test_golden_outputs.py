@@ -48,6 +48,11 @@ def test_golden_issue_counts(file_name, tx_count, module, issue_count,
     with open(os.path.join(INPUTS, file_name)) as handle:
         creation_code = handle.read().strip()
     reset_callback_modules()
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+
+    statistics = SolverStatistics()
+    statistics.enabled = True
+    statistics.solver_time = 0.0
     wrapper = SymExecWrapper(
         creation_code, address=None, strategy="bfs", max_depth=128,
         execution_timeout=240, create_timeout=90, transaction_count=tx_count,
@@ -59,8 +64,11 @@ def test_golden_issue_counts(file_name, tx_count, module, issue_count,
         # where z3's word-level ITE reasoning is instant — the issue IS
         # found with a warm model cache or a generous solver budget
         # (verified: witness matches the reference's calldata exactly).
-        # Known round-5 solver-performance limit, not a detection gap.
-        pytest.xfail("CDCL timeout on the flag_array witness query")
+        # Known round-5 solver-performance limit, not a detection gap —
+        # but only excuse the miss when the solver demonstrably ground
+        # (a cheap-and-empty run would be a real detection regression).
+        if statistics.solver_time > 20:
+            pytest.xfail("CDCL timeout on the flag_array witness query")
     assert len(issues) == issue_count, \
         f"{file_name}: {len(issues)} issues, reference pins {issue_count}"
     if calldata is not None:
