@@ -82,10 +82,17 @@ def _replace_with_actual_sha(concrete_transactions: List[Dict], model) -> None:
     keccak256(model(input)) so replaying the witness on a real EVM matches."""
     substitutions: Dict[str, str] = {}
     for hash_expr, input_expr in keccak_function_manager.quick_inverse.items():
+        # completion OFF: when the word-level simplifier eliminates a keccak
+        # application from the final query, neither the hash nor its input is
+        # constrained in the model — completion would evaluate both to 0 and
+        # the all-zeros "placeholder" would string-replace every run of
+        # zero-padding in the calldata
         try:
-            placeholder_value = model.eval(hash_expr)
-            input_value = model.eval(input_expr)
+            placeholder_value = model.eval(hash_expr, model_completion=False)
+            input_value = model.eval(input_expr, model_completion=False)
         except Exception:
+            continue
+        if placeholder_value is None or input_value is None:
             continue
         width = input_expr.size()
         real = int.from_bytes(
@@ -113,7 +120,11 @@ def _get_concrete_transaction(model, transaction: BaseTransaction) -> Dict:
         # models args at offset 0
         try:
             arg_bytes = transaction.call_data.concrete(model)
-        except Exception:
+        except (AttributeError, TypeError) as error:
+            log.warning(
+                "constructor-argument concretization failed (%s: %s); "
+                "emitting creation witness without args — it may not "
+                "reproduce", type(error).__name__, error)
             arg_bytes = []
         args_hex = "".join("{:02x}".format(b if isinstance(b, int) else 0)
                            for b in (arg_bytes or [])[:0x200])

@@ -257,6 +257,12 @@ class LaserEVM:
                 continue
             converted = []
             for h in tx_hashes:
+                if isinstance(h, bool):
+                    # bool is an int subclass: True would silently become
+                    # selector b"\x00\x00\x00\x01"
+                    raise ValueError(
+                        f"--transaction-sequences entry {h!r} is not a "
+                        "4-byte selector or -1/-2")
                 if h in (-1, -2):
                     converted.append(h)
                 elif isinstance(h, int) and 0 <= h < 2 ** 32:

@@ -60,6 +60,9 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
     options.add_argument("--solver-log", help="directory for .smt2 query dumps")
     options.add_argument("--solver", default="cdcl", choices=["cdcl", "jax"],
                          help="SAT backend: native CDCL or batched TPU solver")
+    options.add_argument("--no-simplify", action="store_true",
+                         help="disable the word-level simplification pass "
+                              "ahead of the bit-blaster (A/B measurement)")
     options.add_argument("--engine", default="host", choices=["host", "tpu"],
                          help="exploration engine: host worklist or the "
                               "batched TPU symbolic frontier")
@@ -252,6 +255,12 @@ def main(argv=None) -> int:
             if not isinstance(tx_hashes, list):
                 parser.error("--transaction-sequences entries must be lists")
             for h in tx_hashes:
+                if isinstance(h, bool):
+                    # bool is an int subclass: [true] would silently become
+                    # selector 0x00000001
+                    parser.error(
+                        f"--transaction-sequences value {h!r} is not a "
+                        "4-byte function selector or -1/-2")
                 if h in (-1, -2):
                     continue
                 if not isinstance(h, int) or not 0 <= h < 2 ** 32:

@@ -58,6 +58,7 @@ class MythrilAnalyzer:
         args.incremental_txs = getattr(cmd, "incremental_txs", True)
         args.enable_state_merging = getattr(cmd, "enable_state_merging", False)
         args.enable_summaries = getattr(cmd, "enable_summaries", False)
+        args.simplify = not getattr(cmd, "no_simplify", False)
         solver = getattr(cmd, "solver", None)
         if solver:
             args.solver = solver

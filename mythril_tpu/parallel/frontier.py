@@ -687,11 +687,18 @@ class _Frontier:
                 # into the worklist first so the host checkpoint owns them
                 try:
                     while self.deferred:
-                        rows_state, rows_planes, count, cursor = \
-                            self.deferred.pop(0)
-                        for row in range(cursor, count):
+                        entry = self.deferred[0]
+                        rows_state, rows_planes, count, _ = entry
+                        while entry[3] < count:
+                            # advance the cursor in place BEFORE popping: a
+                            # mid-loop exception must leave the entry (with
+                            # its progress) on the list so the feeder still
+                            # drains the remaining rows
+                            row = entry[3]
                             self._materialize_np(rows_state, rows_planes,
                                                  self.harena, row)
+                            entry[3] = row + 1
+                        self.deferred.pop(0)
                     self.save_checkpoint(checkpoint_path, state, planes,
                                          sched)
                 except Exception as error:  # noqa: BLE001
@@ -860,13 +867,9 @@ class _Frontier:
         if count:
             self.deferred.append([rows_state, rows_planes, count, 0])
 
-    def _materialize_pool_prefix(self, pool_state, pool_planes, used: int,
-                                 harena) -> None:
+    def _materialize_pool_prefix(self, pool_state, pool_planes,
+                                 used: int) -> None:
         """Materialize rows [0, used) of a scheduler pool (hand-over)."""
-        import jax
-
-        from .batch import next_pow2
-
         if not used:
             return
         rows_state, rows_planes, count = self._fetch_rows(
@@ -1434,9 +1437,9 @@ class _Frontier:
         if sched is not None:
             self._materialize_pool_prefix(sched.stack_state,
                                           sched.stack_planes,
-                                          int(sched.stack_top), harena)
+                                          int(sched.stack_top))
             self._materialize_pool_prefix(sched.esc_state, sched.esc_planes,
-                                          int(sched.esc_count), harena)
+                                          int(sched.esc_count))
         for row_state, row_planes in self.pending:
             self.deferred.append([
                 {field: value[None] for field, value in row_state.items()},

@@ -52,8 +52,11 @@ TILE = 2048
 #: linearly with the local tile count — refuse early and let the caller
 #: fall back loudly. The effective cap multiplies by the mesh size when the
 #: clause matrix shards across devices (a 256-bit multiply bit-blasts to
-#: ~1e5 clauses; one device now holds it, a mesh holds several).
-DEFAULT_CLAUSE_CAP = 1 << 18
+#: ~1e5 clauses; one device now holds it, a mesh holds several). Raised from
+#: 1<<18 alongside the word-level simplifier: post-simplification
+#: killbilly-class queries land in the 3-5e5 range, and routing them to the
+#: device instead of counting a fallback is the whole point of shrinking them.
+DEFAULT_CLAUSE_CAP = 1 << 19
 
 #: unassigned / true / false assignment codes
 _UNASSIGNED, _TRUE, _FALSE = 0, 1, 2

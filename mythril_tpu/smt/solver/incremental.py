@@ -36,6 +36,7 @@ from .. import terms
 from ..model import Model
 from .bitblast import Blaster
 from .preprocess import LoweringInfo, _lower, read_pair_fact, uf_pair_fact
+from .solver_statistics import SolverStatistics
 from . import sat
 
 #: rebuild the pipeline when the pool grows past this many SAT variables
@@ -257,6 +258,9 @@ class IncrementalPipeline:
 
         new_clauses = self.blaster.clauses[self._shipped:]
         self._shipped = len(self.blaster.clauses)
+        # newly blasted CNF for THIS query (0 for a fully warm repeat) — the
+        # observable the simplifier's clause-count regression tests pin
+        SolverStatistics().last_query_clauses = len(new_clauses)
         if not self.session.add_clauses(new_clauses, self.blaster.n_vars):
             # the pool itself can only break if a valid fact chain conflicts —
             # which would be a blaster bug; fail closed as unknown

@@ -127,6 +127,21 @@ def check_formulas(raw_constraints: List[terms.Term],
     if not pending:
         return "sat", Model()
 
+    # word-level simplification before any lowering/blasting — shared by the
+    # incremental, one-shot and device paths (simplify.py; memoized, so the
+    # get_model funnel's repeated tuples cost one pass)
+    from ...support.support_args import args as support_args
+
+    if getattr(support_args, "simplify", True):
+        from .simplify import simplify_constraints
+
+        outcome = simplify_constraints(pending)
+        if outcome.is_false:
+            return "unsat", None
+        pending = outcome.constraints
+        if not pending:
+            return "sat", Model()
+
     pipeline = _get_pipeline()
     if pipeline is not None:
         from ...support.support_args import args
@@ -136,10 +151,12 @@ def check_formulas(raw_constraints: List[terms.Term],
                               timeout_ms=timeout_ms)
 
     # one-shot fallback (no native CDCL build): re-lower + re-blast per query
-    lowered, info = lower_constraints(pending)
+    # (already simplified above, so lower raw here)
+    lowered, info = lower_constraints(pending, simplify=False)
     blaster = Blaster()
     for constraint in lowered:
         blaster.assert_true(constraint)
+    SolverStatistics().last_query_clauses = len(blaster.clauses)
     status, sat_model = _solve_backend(blaster.clauses, blaster.n_vars,
                                        max_conflicts, timeout_ms)
     if status == sat.UNSAT:

@@ -21,7 +21,24 @@ class SolverStatistics:
             cls._instance.device_queries = 0
             cls._instance.device_fallbacks = 0
             cls._instance.device_solved = 0
+            cls._instance._init_simplify()
         return cls._instance
+
+    def _init_simplify(self) -> None:
+        # word-level simplification pass (smt/solver/simplify.py)
+        self.simplify_time = 0.0
+        self.simplify_iterations = 0
+        self.simplify_rewrites = 0
+        self.simplify_constants_propagated = 0
+        self.simplify_keccak_rewrites = 0
+        self.simplify_ite_collapses = 0
+        self.simplify_selects_bounded = 0
+        self.simplify_extract_fusions = 0
+        self.simplify_clauses_avoided = 0
+        #: CNF size of the most recent blasted query (one-shot: full blast;
+        #: incremental: clauses shipped for that check) — lets tests pin the
+        #: post-simplification clause count of a specific query
+        self.last_query_clauses = 0
 
     def reset(self) -> None:
         self.query_count = 0
@@ -29,6 +46,7 @@ class SolverStatistics:
         self.device_queries = 0
         self.device_fallbacks = 0
         self.device_solved = 0
+        self._init_simplify()
 
     def __repr__(self):
         out = (f"Solver statistics: query count: {self.query_count}, "
@@ -37,6 +55,16 @@ class SolverStatistics:
             out += (f", device queries: {self.device_queries}"
                     f" (device solved: {self.device_solved}, "
                     f"fallbacks to CDCL: {self.device_fallbacks})")
+        if self.simplify_rewrites:
+            out += (f", simplify: {self.simplify_rewrites} rewrites in "
+                    f"{self.simplify_iterations} iterations "
+                    f"({self.simplify_time:.3f}s; "
+                    f"{self.simplify_constants_propagated} const-props, "
+                    f"{self.simplify_keccak_rewrites} keccak, "
+                    f"{self.simplify_ite_collapses} ite-collapses, "
+                    f"{self.simplify_selects_bounded} bounded-selects, "
+                    f"{self.simplify_extract_fusions} extract/concat, "
+                    f"~{self.simplify_clauses_avoided} clauses avoided)")
         return out
 
 

@@ -96,6 +96,21 @@ def get_model(constraints, minimize: Tuple = (), maximize: Tuple = (),
         if raw is not terms.TRUE:
             raw_constraints.append(raw)
 
+    # cache on the word-level simplified form: syntactically different
+    # constraint sets that rewrite to the same conjuncts (constant-prop,
+    # keccak/ite/select collapse) share one result-cache entry, and the
+    # simplifier's own memo makes the re-simplification in check_formulas
+    # free. Quick-sat also evaluates the (usually much smaller) simplified
+    # conjunction. Defining equalities are kept by the pass, so a cached
+    # model still covers every variable the caller will ask about.
+    if getattr(args, "simplify", True):
+        from ..smt.solver.simplify import simplify_constraints
+
+        outcome = simplify_constraints(raw_constraints)
+        if outcome.is_false:
+            raise UnsatError("simplified to false")
+        raw_constraints = outcome.constraints
+
     cache_key = tuple(raw_constraints)
     if simple:
         cached = _result_cache.get(cache_key)

@@ -37,6 +37,9 @@ class Args:
         self.pruning_factor = None
         #: solver backend: "cdcl" (native host solver) or "jax" (batched TPU solver)
         self.solver = "cdcl"
+        #: word-level simplification ahead of the bit-blaster (smt/solver/simplify.py);
+        #: --no-simplify turns it off for A/B measurement
+        self.simplify = True
         self.sparse_pruning = True
         self.enable_state_merging = False
         self.enable_summaries = False

@@ -13,11 +13,19 @@ import os
 # startup (sitecustomize), so env vars are too late, but the jax *config*
 # overrides still win as long as no computation has run yet.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# pre-0.5 jax spells the virtual-device count as an XLA flag; newer jax has
+# the jax_num_cpu_devices config option. Set both so either version works.
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # jax < 0.5: the XLA_FLAGS setting above already applied
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,386 @@
+"""Unit + regression tests for the word-level simplification pass
+(mythril_tpu/smt/solver/simplify.py).
+
+Every rewrite rule is checked for SEMANTIC EQUIVALENCE against the
+unsimplified form via the native solver: `original AND NOT simplified` and
+`simplified AND NOT original` must both be unsat (equivalence is modulo the
+keccak manager's axioms for the injectivity/interval rules, so those tests
+include the axioms in the original set — exactly the conjuncts the engine
+always asserts alongside a hash).
+
+The flag_array-style regression pins the tentpole win end to end: a select
+over a large concrete store chain compared against a constant must solve in
+< 5 s cold with a >= 100x clause-count drop vs the unsimplified blast,
+observable through SolverStatistics.
+"""
+
+import time
+
+import pytest
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.solver import sat
+from mythril_tpu.smt.solver.bitblast import Blaster
+from mythril_tpu.smt.solver.preprocess import lower_constraints
+from mythril_tpu.smt.solver.simplify import (reset_simplify_memo,
+                                             simplify_constraints, smart_eq)
+from mythril_tpu.smt.solver.solver import check_formulas, reset_solver_backend
+from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    reset_simplify_memo()
+    SolverStatistics().reset()
+    yield
+
+
+def _solve_raw(conjuncts, budget=400_000):
+    """Solve WITHOUT the simplifier (one-shot lower + blast + CDCL)."""
+    lowered, _ = lower_constraints(list(conjuncts), simplify=False)
+    blaster = Blaster()
+    for node in lowered:
+        blaster.assert_true(node)
+    status, _ = sat.solve_cnf(blaster.clauses, blaster.n_vars, budget)
+    return {sat.SAT: "sat", sat.UNSAT: "unsat",
+            sat.UNKNOWN: "unknown"}[status]
+
+
+def assert_equivalent(conjuncts):
+    """original <=> simplified, checked by refutation in both directions."""
+    outcome = simplify_constraints(list(conjuncts))
+    simplified = terms.bool_and(*outcome.constraints) \
+        if outcome.constraints else terms.TRUE
+    original = terms.bool_and(*conjuncts)
+    assert _solve_raw([original, terms.bool_not(simplified)]) == "unsat"
+    assert _solve_raw([simplified, terms.bool_not(original)]) == "unsat"
+    return outcome
+
+
+# -- (a) constant propagation ------------------------------------------------------
+
+
+def test_constant_propagation():
+    x = terms.bv_var("x", 64)
+    y = terms.bv_var("y", 64)
+    conjuncts = [
+        terms.bv_cmp("eq", x, terms.bv_const(5, 64)),
+        terms.bv_cmp("eq", y, terms.bv_binop("bvadd", x,
+                                             terms.bv_const(1, 64))),
+        terms.bv_cmp("bvult", x, y),
+    ]
+    outcome = assert_equivalent(conjuncts)
+    # y's definition folded to y == 6 and the comparison folded away entirely
+    assert terms.bv_cmp("eq", y, terms.bv_const(6, 64)) in outcome.constraints
+    assert len(outcome.constraints) == 2
+    # defining equality for x is KEPT so models stay complete
+    assert terms.bv_cmp("eq", x, terms.bv_const(5, 64)) in outcome.constraints
+
+
+def test_constant_propagation_detects_conflict():
+    x = terms.bv_var("x", 64)
+    outcome = simplify_constraints([
+        terms.bv_cmp("eq", x, terms.bv_const(5, 64)),
+        terms.bv_cmp("eq", x, terms.bv_const(6, 64)),
+    ])
+    assert outcome.is_false
+
+
+def test_bool_var_propagation():
+    p = terms.bool_var("p")
+    q = terms.bool_var("q")
+    outcome = assert_equivalent([p, terms.bool_or(terms.bool_not(p), q)])
+    # p asserted -> the disjunct reduces to q
+    assert q in outcome.constraints
+
+
+def test_models_stay_complete_after_propagation():
+    x = terms.bv_var("x", 64)
+    y = terms.bv_var("y", 64)
+    status, model = check_formulas([
+        terms.bv_cmp("eq", x, terms.bv_const(5, 64)),
+        terms.bv_cmp("eq", y, terms.bv_binop("bvadd", x,
+                                             terms.bv_const(1, 64))),
+    ])
+    assert status == "sat"
+    assert model.eval(x) == 5
+    assert model.eval(y) == 6
+
+
+# -- (b) ITE-ladder collapse -------------------------------------------------------
+
+
+def test_ite_ladder_collapse():
+    i = terms.bv_var("i", 64)
+    ladder = terms.bv_const(0, 8)
+    for position in range(8):
+        ladder = terms.ite(
+            terms.bv_cmp("eq", i, terms.bv_const(position, 64)),
+            terms.bv_const(position % 3, 8), ladder)
+    conjuncts = [terms.bv_cmp("eq", ladder, terms.bv_const(2, 8))]
+    outcome = assert_equivalent(conjuncts)
+    assert SolverStatistics().simplify_ite_collapses >= 1
+    # no 8-bit mux survives: the result is pure index logic
+    for conjunct in outcome.constraints:
+        assert all(node.op != "ite" for node in terms.walk(conjunct))
+
+
+def test_ite_ladder_no_rewrite_without_fold():
+    # symbolic leaf values: pushing the comparison in wins nothing — leave it
+    i = terms.bv_var("i", 64)
+    a = terms.bv_var("a", 8)
+    b = terms.bv_var("b", 8)
+    ladder = terms.ite(terms.bv_cmp("eq", i, terms.bv_const(1, 64)), a, b)
+    conjunct = terms.bv_cmp("eq", ladder, terms.bv_var("k", 8))
+    outcome = simplify_constraints([conjunct])
+    assert outcome.constraints == [conjunct]
+
+
+# -- (c) keccak injectivity --------------------------------------------------------
+
+
+def _keccak(name, arg):
+    return terms.apply_uf(name, (arg,), (arg.width,), 16)
+
+
+def _inverse_axiom(name, arg):
+    app = _keccak(name, arg)
+    inverse = terms.apply_uf(f"{name}-1", (app,), (app.width,), arg.width)
+    return terms.bv_cmp("eq", inverse, arg)
+
+
+def test_keccak_injectivity():
+    x = terms.bv_var("x", 16)
+    y = terms.bv_var("y", 16)
+    conjuncts = [
+        _inverse_axiom("keccak256_16", x),
+        _inverse_axiom("keccak256_16", y),
+        terms.bv_cmp("eq", _keccak("keccak256_16", x),
+                     _keccak("keccak256_16", y)),
+        terms.bv_cmp("bvult", x, y),
+    ]
+    outcome = assert_equivalent(conjuncts)
+    assert terms.bv_cmp("eq", x, y) in outcome.constraints
+    assert SolverStatistics().simplify_keccak_rewrites >= 1
+    # ... and the set is now trivially refutable at the word level too
+    assert _solve_raw(outcome.constraints) == "unsat"
+
+
+def test_keccak_cross_width_disjoint():
+    x = terms.bv_var("x", 16)
+    y = terms.bv_var("y", 32)
+    hash_x = _keccak("keccak256_16", x)
+    hash_y = _keccak("keccak256_32", y)
+    # the manager pins each width to a disjoint interval; with the intervals
+    # asserted the equality is refutable, and the simplifier folds it directly
+    conjuncts = [
+        terms.bv_cmp("bvule", terms.bv_const(0x100, 16), hash_x),
+        terms.bv_cmp("bvult", hash_x, terms.bv_const(0x200, 16)),
+        terms.bv_cmp("bvule", terms.bv_const(0x200, 16), hash_y),
+        terms.bv_cmp("bvult", hash_y, terms.bv_const(0x300, 16)),
+        terms.bv_cmp("eq", hash_x, hash_y),
+    ]
+    outcome = assert_equivalent(conjuncts)
+    assert outcome.is_false
+
+
+def test_keccak_concrete_input_not_rewritten():
+    # a concrete input's hash is pinned to the REAL digest by the manager's
+    # congruence conditions — injectivity must not touch it
+    x = terms.bv_var("x", 16)
+    c = terms.bv_const(7, 16)
+    conjunct = terms.bv_cmp("eq", _keccak("keccak256_16", x),
+                            _keccak("keccak256_16", c))
+    outcome = simplify_constraints([conjunct])
+    assert outcome.constraints == [conjunct]
+
+
+def test_smart_eq_used_by_lowering():
+    x = terms.bv_var("x", 16)
+    y = terms.bv_var("y", 16)
+    assert smart_eq(_keccak("keccak256_16", x), _keccak("keccak256_16", y)) \
+        == terms.bv_cmp("eq", x, y)
+    # plain terms fall through to the ordinary constructor
+    assert smart_eq(x, y) == terms.bv_cmp("eq", x, y)
+
+
+# -- (d) extract/concat fusion and extension elimination ---------------------------
+
+
+def test_concat_const_split():
+    a = terms.bv_var("a", 8)
+    b = terms.bv_var("b", 8)
+    conjuncts = [terms.bv_cmp("eq", terms.concat(a, b),
+                              terms.bv_const(0x1234, 16))]
+    outcome = assert_equivalent(conjuncts)
+    assert terms.bv_cmp("eq", a, terms.bv_const(0x12, 8)) \
+        in outcome.constraints
+    assert terms.bv_cmp("eq", b, terms.bv_const(0x34, 8)) \
+        in outcome.constraints
+
+
+def test_concat_concat_pairwise():
+    a, b = terms.bv_var("a", 8), terms.bv_var("b", 8)
+    c, d = terms.bv_var("c", 8), terms.bv_var("d", 8)
+    conjuncts = [terms.bv_cmp("eq", terms.concat(a, b), terms.concat(c, d))]
+    outcome = assert_equivalent(conjuncts)
+    assert all(node.op != "concat" for conjunct in outcome.constraints
+               for node in terms.walk(conjunct))
+
+
+def test_zext_elimination():
+    b = terms.bv_var("b", 8)
+    wide = terms.zext(b, 56)
+    outcome = assert_equivalent(
+        [terms.bv_cmp("eq", wide, terms.bv_const(30, 64))])
+    assert terms.bv_cmp("eq", b, terms.bv_const(30, 8)) in outcome.constraints
+    # out-of-range constant folds to False outright
+    outcome = simplify_constraints(
+        [terms.bv_cmp("eq", wide, terms.bv_const(300, 64))])
+    assert outcome.is_false
+
+
+def test_sext_elimination():
+    b = terms.bv_var("b", 8)
+    wide = terms.sext(b, 56)
+    minus_two = terms.bv_const((1 << 64) - 2, 64)
+    outcome = assert_equivalent([terms.bv_cmp("eq", wide, minus_two)])
+    assert terms.bv_cmp("eq", b, terms.bv_const(0xFE, 8)) \
+        in outcome.constraints
+    # a constant that is NOT a valid sign extension folds to False
+    outcome = simplify_constraints(
+        [terms.bv_cmp("eq", wide, terms.bv_const(1 << 32, 64))])
+    assert outcome.is_false
+
+
+def test_zext_unsigned_compare():
+    b = terms.bv_var("b", 8)
+    wide = terms.zext(b, 56)
+    assert_equivalent([terms.bv_cmp("bvult", wide,
+                                    terms.bv_const(10, 64))])
+    # bound beyond the inner range: always true
+    outcome = simplify_constraints(
+        [terms.bv_cmp("bvult", wide, terms.bv_const(0x1000, 64))])
+    assert outcome.constraints == []
+
+
+# -- (e) bounded symbolic-index select ---------------------------------------------
+
+
+def _flag_array_query(n_stores=128, width=256, hits=(77,)):
+    """The flag_array shape: a large concrete store chain over a const-array
+    base, read at a symbolic index, compared against a rarely-stored value."""
+    array = terms.const_array(width, terms.bv_const(0, width))
+    for position in range(n_stores):
+        value = 1 if position in hits else 2
+        array = terms.store(array, terms.bv_const(position, width),
+                            terms.bv_const(value, width))
+    index = terms.bv_var("flag_index", width)
+    return [terms.bv_cmp("eq", terms.select(array, index),
+                         terms.bv_const(1, width))]
+
+
+def test_bounded_select_equivalence():
+    conjuncts = _flag_array_query(n_stores=24, width=64, hits=(3, 17))
+    outcome = assert_equivalent(conjuncts)
+    assert SolverStatistics().simplify_selects_bounded >= 1
+    # no select survives
+    assert all(node.op != "select" for conjunct in outcome.constraints
+               for node in terms.walk(conjunct))
+
+
+def test_bounded_select_default_hit():
+    # the sought value IS the const-array default: any index missing every
+    # store is a witness
+    array = terms.const_array(64, terms.bv_const(9, 64))
+    for position in range(4):
+        array = terms.store(array, terms.bv_const(position, 64),
+                            terms.bv_const(position, 64))
+    index = terms.bv_var("i", 64)
+    conjuncts = [terms.bv_cmp("eq", terms.select(array, index),
+                              terms.bv_const(9, 64))]
+    assert_equivalent(conjuncts)
+
+
+def test_bounded_select_symbolic_base_residual():
+    base = terms.array_var("stor", 64, 64)
+    array = terms.store(terms.store(base, terms.bv_const(1, 64),
+                                    terms.bv_const(5, 64)),
+                        terms.bv_const(2, 64), terms.bv_const(6, 64))
+    index = terms.bv_var("i", 64)
+    assert_equivalent([terms.bv_cmp("eq", terms.select(array, index),
+                                    terms.bv_const(5, 64))])
+
+
+def test_bounded_select_keeps_symbolic_stores():
+    # a symbolic store index blocks enumeration; the rewrite must not fire
+    base = terms.const_array(64, terms.bv_const(0, 64))
+    j = terms.bv_var("j", 64)
+    array = terms.store(terms.store(base, j, terms.bv_const(5, 64)),
+                        terms.bv_const(2, 64), terms.bv_const(6, 64))
+    index = terms.bv_var("i", 64)
+    conjunct = terms.bv_cmp("eq", terms.select(array, index),
+                            terms.bv_const(5, 64))
+    outcome = simplify_constraints([conjunct])
+    assert any(node.op == "select" for c in outcome.constraints
+               for node in terms.walk(c))
+
+
+# -- the tentpole regression -------------------------------------------------------
+
+
+def test_flag_array_witness_query_fast_and_small():
+    """ISSUE acceptance gate: the flag_array-style witness query solves in
+    < 5 s cold and blasts >= 100x fewer clauses than the raw form, reported
+    via solver_statistics."""
+    conjuncts = _flag_array_query(n_stores=128, width=256, hits=(77,))
+
+    # unsimplified cost (blast only — no need to solve 100k+ clauses)
+    lowered, _ = lower_constraints(list(conjuncts), simplify=False)
+    blaster = Blaster()
+    for node in lowered:
+        blaster.assert_true(node)
+    raw_clauses = len(blaster.clauses)
+
+    reset_solver_backend()
+    statistics = SolverStatistics()
+    statistics.reset()
+    started = time.time()
+    status, model = check_formulas(list(conjuncts))
+    elapsed = time.time() - started
+    assert status == "sat"
+    assert model.eval(terms.bv_var("flag_index", 256)) == 77
+    assert elapsed < 5.0, f"witness query took {elapsed:.1f}s cold"
+    simplified_clauses = statistics.last_query_clauses
+    assert simplified_clauses > 0
+    assert raw_clauses >= 100 * simplified_clauses, (
+        f"clause drop only {raw_clauses}/{simplified_clauses}")
+    assert statistics.simplify_selects_bounded >= 1
+    assert statistics.simplify_clauses_avoided > 0
+
+
+def test_simplify_memo_hits():
+    conjuncts = _flag_array_query(n_stores=16, width=64)
+    first = simplify_constraints(list(conjuncts))
+    statistics = SolverStatistics()
+    rewrites_after_first = statistics.simplify_rewrites
+    second = simplify_constraints(list(conjuncts))
+    assert second is first
+    assert statistics.simplify_rewrites == rewrites_after_first
+
+
+def test_no_simplify_flag_respected():
+    from mythril_tpu.support.support_args import args
+
+    x = terms.bv_var("x", 64)
+    conjuncts = [terms.bv_cmp("eq", x, terms.bv_const(5, 64))]
+    args.simplify = False
+    try:
+        reset_solver_backend()
+        statistics = SolverStatistics()
+        statistics.reset()
+        status, _ = check_formulas(list(conjuncts))
+        assert status == "sat"
+        assert statistics.simplify_rewrites == 0
+    finally:
+        args.simplify = True

@@ -86,22 +86,65 @@ def test_oneshot_vs_incremental(corpus):
         f"only {decided}/{len(corpus)} queries decided by both backends"
 
 
-def test_device_backend_issue_parity():
-    """VERDICT r2 done-criterion: `analyze --solver jax` must report the
-    identical issue set as `--solver cdcl` (the r2 build reported zero issues
-    because a TPU-side crash was swallowed)."""
+def _issue_parity(contract, modules, tx_count):
     import sys
     sys.path.insert(0, os.path.dirname(__file__))
-    from test_analysis import analyze, KILLBILLY
+    from test_analysis import analyze
 
     from mythril_tpu.support.support_args import args
 
-    baseline = analyze(KILLBILLY, modules=["AccidentallyKillable"], tx_count=2)
+    baseline = analyze(contract, modules=modules, tx_count=tx_count)
     args.solver = "jax"
     try:
-        device = analyze(KILLBILLY, modules=["AccidentallyKillable"],
-                         tx_count=2)
+        device = analyze(contract, modules=modules, tx_count=tx_count)
     finally:
         args.solver = "cdcl"
-    assert sorted(i.swc_id for i in device) == sorted(
-        i.swc_id for i in baseline) == ["106"]
+    return sorted(i.swc_id for i in baseline), sorted(i.swc_id
+                                                      for i in device)
+
+
+def test_device_backend_issue_parity_smoke(monkeypatch):
+    """Always-on slice of the device/host issue-parity check.
+
+    The r2 failure mode was a TPU-side crash swallowed into "zero issues" —
+    a ROUTING bug, not a kernel bug (the kernel is differentially tested on
+    random CNFs in test_jax_solver.py). This slice pins the routing end to
+    end — `--solver jax` analysis must report the host lane's issues — while
+    forcing every device attempt through the oversize/fallback path with a
+    tiny clause cap, because an actual device solve pays minutes of XLA
+    compile per clause-shape bucket on the CI CPU mesh (that full replay is
+    the slow-marked test below)."""
+    from mythril_tpu.parallel import jax_solver
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+
+    original = jax_solver.solve_cnf_device
+
+    def tiny_cap(clauses, n_vars, **kwargs):
+        kwargs["clause_cap"] = 8
+        return original(clauses, n_vars, **kwargs)
+
+    monkeypatch.setattr(jax_solver, "solve_cnf_device", tiny_cap)
+    statistics = SolverStatistics()
+    statistics.reset()
+    host, device = _issue_parity(
+        {"die()": "CALLER\nSELFDESTRUCT"}, ["AccidentallyKillable"], 1)
+    assert host == device == ["106"]
+    # the device lane really was consulted and really fell back loudly
+    assert statistics.device_queries > 0
+    assert statistics.device_fallbacks == statistics.device_queries
+
+
+@pytest.mark.slow
+def test_device_backend_issue_parity():
+    """VERDICT r2 done-criterion: `analyze --solver jax` must report the
+    identical issue set as `--solver cdcl` (the r2 build reported zero issues
+    because a TPU-side crash was swallowed). Full two-tx replay with real
+    device solves: ~9 min of wall time (per-shape XLA compiles on the CPU
+    mesh), so it rides the slow lane; the routing smoke above stays in
+    tier 1."""
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_analysis import KILLBILLY
+
+    host, device = _issue_parity(KILLBILLY, ["AccidentallyKillable"], 2)
+    assert host == device == ["106"]
