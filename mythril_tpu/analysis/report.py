@@ -122,6 +122,13 @@ class Report:
         self.source = contracts
         self.exceptions = exceptions or []
         self.execution_info = execution_info or []
+        #: the global analysis deadline fired and the frontier was drained
+        #: gracefully: issues found so far are valid, but exploration is
+        #: partial (core/svm.py graceful drain)
+        self.incomplete = False
+        #: coverage stats accompanying an incomplete report (executed nodes,
+        #: explored/dropped state counts, transactions reached)
+        self.coverage: Dict = {}
 
     def sorted_issues(self) -> List[Dict]:
         return [issue.as_dict for key, issue in
@@ -133,11 +140,24 @@ class Report:
         self.issues[key] = issue
 
     # -- formats --------------------------------------------------------------------
+    def _incomplete_banner(self) -> str:
+        stats = ", ".join(f"{key}: {value}" for key, value
+                          in self.coverage.items())
+        return ("==== INCOMPLETE ANALYSIS ====\n"
+                "The analysis deadline expired before exploration finished; "
+                "the results below are valid but partial.\n"
+                + (f"Coverage: {stats}\n" if stats else ""))
+
     def as_text(self) -> str:
         if not self.issues:
+            if self.incomplete:
+                return self._incomplete_banner() + \
+                    "No issues were detected in the explored portion.\n"
             return "The analysis was completed successfully. " \
                    "No issues were detected.\n"
         blocks = []
+        if self.incomplete:
+            blocks.append(self._incomplete_banner())
         for issue in (issue for _, issue in
                       sorted(self.issues.items(), key=lambda kv: kv[1].address)):
             lines = [
@@ -175,6 +195,9 @@ class Report:
 
     def as_json(self) -> str:
         result = {"success": True, "error": None, "issues": self.sorted_issues()}
+        if self.incomplete:
+            result["incomplete"] = True
+            result["coverage"] = self.coverage
         if self.execution_info:
             result["extra"] = {
                 "execution_info": [info.as_dict() for info in self.execution_info]}

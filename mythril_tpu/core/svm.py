@@ -73,6 +73,13 @@ class LaserEVM:
         #: ever runs, so it must not share the attribute
         self._device_resume_path = resume_path
         self._current_tx_index = 0
+        #: set when the global analysis deadline fired mid-exploration: the
+        #: run drained gracefully (final checkpoint + partial report flagged
+        #: `incomplete`) instead of dying mid-transaction
+        self.timed_out = False
+        #: worklist states abandoned at the deadline (coverage stat)
+        self.dropped_states = 0
+        self._states_since_checkpoint = 0
         import time as time_module
 
         # a 0.0 sentinel vs monotonic() would force a full checkpoint pickle
@@ -239,6 +246,7 @@ class LaserEVM:
         save_host_checkpoint(self.checkpoint_path, self, tx_index,
                              in_flight=in_flight)
         self._last_checkpoint_time = time_module.monotonic()
+        self._states_since_checkpoint = 0
 
     @staticmethod
     def _cli_transaction_sequences() -> List[Optional[List]]:
@@ -333,14 +341,26 @@ class LaserEVM:
         END exec (timeout), or None when the worklist ran dry."""
         import time as time_module
 
-        from ..support.checkpoint import SAVE_INTERVAL_S
+        from ..support.checkpoint import (SAVE_INTERVAL_S,
+                                          checkpoint_state_interval)
+        from ..support import resilience
 
+        state_interval = checkpoint_state_interval()
         for global_state in self.strategy:
+            if not create:
+                # deterministic host-crash injection boundary
+                # (`--inject-fault host_crash:N` kills the run at exactly the
+                # Nth popped message-call state — the checkpoint/resume
+                # equivalent of kill -9)
+                resilience.fire("host")
+                self._states_since_checkpoint += 1
             if self.checkpoint_path and not create and \
-                    time_module.monotonic() - self._last_checkpoint_time \
-                    > SAVE_INTERVAL_S:
-                # periodic mid-transaction save; the popped state rides along
-                # so a kill between here and execute_state loses nothing
+                    (time_module.monotonic() - self._last_checkpoint_time
+                     > SAVE_INTERVAL_S
+                     or self._states_since_checkpoint >= state_interval):
+                # periodic mid-transaction save (time OR state-count
+                # cadence); the popped state rides along so a kill between
+                # here and execute_state loses nothing
                 self._save_checkpoint(self._current_tx_index,
                                       in_flight=global_state)
             if create and self.create_timeout and \
@@ -350,7 +370,17 @@ class LaserEVM:
                     else _EXEC_TIMED_OUT
             if not create and self.execution_timeout and \
                     self.time + timedelta(seconds=self.execution_timeout) <= datetime.now():
-                log.debug("hit execution timeout, returning")
+                # global deadline: drain gracefully — count the abandoned
+                # frontier, checkpoint it (popped state included), and let
+                # the analyzer emit a partial report flagged `incomplete`
+                self.timed_out = True
+                self.dropped_states += len(self.work_list) + 1
+                log.warning(
+                    "hit execution timeout with %d worklist states pending "
+                    "— draining gracefully (checkpoint + partial report)",
+                    len(self.work_list) + 1)
+                self._save_checkpoint(self._current_tx_index,
+                                      in_flight=global_state)
                 return final_states + self.work_list if track_gas \
                     else _EXEC_TIMED_OUT
 

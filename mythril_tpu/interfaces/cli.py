@@ -77,6 +77,20 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
                          help="resume a killed analysis from --checkpoint "
                               "state; corrupt/absent checkpoints degrade to "
                               "a fresh run")
+    options.add_argument("--inject-fault", default=None,
+                         metavar="CLASS[:NTH]",
+                         help="deterministic fault injection for resilience "
+                              "testing: fire failure CLASS (device_oom, "
+                              "compile_error, wall_overrun, worker_crash, "
+                              "native_crash, divergence, host_crash) at the "
+                              "NTH visit of its boundary (N, N+, or *; "
+                              "default 1); comma-separate multiple entries")
+    options.add_argument("--device-crosscheck", type=int, default=0,
+                         metavar="N",
+                         help="re-decide every Nth device sat/unsat verdict "
+                              "on the host CDCL oracle; any divergence "
+                              "quarantines the device backend for the run "
+                              "(0 = off)")
 
     output = parser.add_argument_group("output")
     output.add_argument("-o", "--outform", default="text",

@@ -59,9 +59,16 @@ class MythrilAnalyzer:
         args.enable_state_merging = getattr(cmd, "enable_state_merging", False)
         args.enable_summaries = getattr(cmd, "enable_summaries", False)
         args.simplify = not getattr(cmd, "no_simplify", False)
+        args.device_crosscheck = getattr(cmd, "device_crosscheck", 0)
+        args.inject_fault = getattr(cmd, "inject_fault", None)
         solver = getattr(cmd, "solver", None)
         if solver:
             args.solver = solver
+        # arm the deterministic fault plan (support/resilience.py) for this
+        # analyzer — a no-op (disarmed plan) when --inject-fault is absent
+        from ..support import resilience
+
+        resilience.configure(args.inject_fault)
 
     def _dynloader(self):
         if self.use_onchain_data and self.eth is not None:
@@ -104,8 +111,11 @@ class MythrilAnalyzer:
         """Run detection on every loaded contract (reference :133-200)."""
         all_issues: List[Issue] = []
         exceptions = []
+        incomplete = False
+        coverage: dict = {}
         for contract in self.contracts:
             SolverStatistics().reset()
+            sym = None
             try:
                 sym = SymExecWrapper(
                     contract,
@@ -133,6 +143,21 @@ class MythrilAnalyzer:
                 exceptions.append(traceback.format_exc())
                 issues = retrieve_callback_issues(modules)
             log.info("solver statistics: %s", SolverStatistics())
+            laser = getattr(sym, "laser", None)
+            if laser is not None and getattr(laser, "timed_out", False):
+                # deadline drain (core/svm.py): the report stays valid but
+                # must say it is partial, with what-was-covered stats
+                incomplete = True
+                coverage = {
+                    "executed_nodes": laser.executed_nodes,
+                    "explored_states": laser.total_states,
+                    "dropped_states": getattr(laser, "dropped_states", 0),
+                    "open_states": len(laser.open_states),
+                    "transactions_reached":
+                        getattr(laser, "_current_tx_index", 0) + 1,
+                }
+                log.warning("analysis of %s is INCOMPLETE (deadline drain): "
+                            "%s", contract.name, coverage)
             for issue in issues:
                 issue.add_code_info(contract)
             all_issues.extend(issues)
@@ -141,6 +166,8 @@ class MythrilAnalyzer:
                        for c in self.contracts]
         report = Report(contracts=self.contracts, exceptions=exceptions)
         report.source = source_data
+        report.incomplete = incomplete
+        report.coverage = coverage
         for issue in all_issues:
             report.append_issue(issue)
         return report

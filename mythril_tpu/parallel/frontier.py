@@ -1270,8 +1270,9 @@ class _Frontier:
         """Dense-array frontier checkpoint (SURVEY §5: 'dense arrays
         serialize trivially'): one .npz holding the device phase —
         StateBatch planes, symbolic planes, the USED prefix of the
-        expression arena, and lane bookkeeping. Written atomically
-        (tmp + os.replace) so preemption mid-write never corrupts the only
+        expression arena, and lane bookkeeping. Written crash-safe
+        (tmp + fsync + os.replace, support/checkpoint.py fsync_replace) so
+        preemption or power loss mid-write never corrupts the only
         checkpoint. Scope: the device phase only — states already
         materialized onto the host worklist are drained by the host
         continuation and are not re-created on resume."""
@@ -1333,12 +1334,12 @@ class _Frontier:
                 dtype=np.uint8)
         finally:
             sys_module.setrecursionlimit(limit)
-        import os
+        from ..support.checkpoint import fsync_replace
 
         tmp = f"{path}.tmp"
         with open(tmp, "wb") as handle:
             np.savez_compressed(handle, **arrays)
-        os.replace(tmp, path)
+        fsync_replace(tmp, path)
 
     def load_checkpoint(self, path: str):
         """Restore (state, planes) saved by save_checkpoint; the arena and
@@ -1424,6 +1425,11 @@ class _Frontier:
             log.info("execution budget exhausted with %d live lanes + %d "
                      "backlog rows; dropping them (host-timeout parity)",
                      len(live), backlog)
+            # graceful-drain accounting: the partial report's coverage
+            # stats count these alongside the host's own dropped states
+            self.laser.timed_out = True
+            self.laser.dropped_states = getattr(
+                self.laser, "dropped_states", 0) + len(live) + backlog
             return
         if not len(live) and not backlog:
             return
@@ -1544,4 +1550,7 @@ def execute_message_call_tpu(laser_evm, callee_address,
             log.info("execution budget exhausted with %d deferred frontier "
                      "rows unmaterialized; dropping them (host-timeout "
                      "parity)", dropped)
+            laser_evm.timed_out = True
+            laser_evm.dropped_states = getattr(
+                laser_evm, "dropped_states", 0) + dropped
             del frontier.deferred[:]

@@ -30,7 +30,10 @@ loud fallback (solver.py counts the fallbacks).
 from __future__ import annotations
 
 import itertools
+import logging
 from typing import Dict, FrozenSet, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
 
 from .. import terms
 from ..model import Model
@@ -284,14 +287,48 @@ class IncrementalPipeline:
                         if sub_var - 1 < len(sub_bits):
                             bits[global_var - 1] = sub_bits[sub_var - 1]
         if status == sat.UNKNOWN:
-            status, bits = self.session.solve(
-                assumptions, self.blaster.n_vars, max_conflicts, timeout_ms)
+            status, bits = self._session_solve(assumptions, max_conflicts,
+                                               timeout_ms)
 
         if status == sat.UNSAT:
             return "unsat", None
         if status == sat.UNKNOWN:
             return "unknown", None
         return "sat", self._build_model(bits, fresh_vars, lowered)
+
+    def _session_solve(self, assumptions: List[int], max_conflicts: int,
+                       timeout_ms: int) -> Tuple[int, Optional[List[bool]]]:
+        """Native session solve behind its circuit breaker
+        (support/resilience.py), degrading to the pure-Python DPLL over the
+        full pool + one unit per assumption — the ladder floor decides the
+        same question, just much slower."""
+        from ...support import resilience
+
+        health = resilience.registry.backend(resilience.NATIVE)
+        if health.allow():
+            try:
+                resilience.fire(resilience.NATIVE)
+                status, bits = self.session.solve(
+                    assumptions, self.blaster.n_vars, max_conflicts,
+                    timeout_ms)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as error:
+                failure_class = (error.failure_class
+                                 if isinstance(error,
+                                               resilience.BackendFailure)
+                                 else resilience.NATIVE_CRASH)
+                log.warning(
+                    "native CDCL session failed [%s] (%r) under %d "
+                    "assumptions — degrading to the pure-Python DPLL",
+                    failure_class, error, len(assumptions))
+                health.record_failure(failure_class, repr(error))
+            else:
+                health.record_success()
+                return status, bits
+        return sat.solve_cnf_python(
+            self.blaster.clauses + [[lit] for lit in assumptions],
+            self.blaster.n_vars, max_conflicts)
 
     def _device_subproblem(self, assumptions: List[int],
                            fresh_vars: FrozenSet[terms.Term]):

@@ -152,19 +152,67 @@ class Session:
             pass
 
 
+def solve_cnf_native(clauses: List[List[int]], n_vars: int,
+                     max_conflicts: int = 2_000_000, timeout_ms: int = 0
+                     ) -> Tuple[int, Optional[List[bool]]]:
+    """One-shot native CDCL solve. Raises NativeCrash when the library is
+    unavailable — callers wanting graceful degradation go through solve_cnf."""
+    lib = _load_lib()
+    if lib is None:
+        from ...support.resilience import NativeCrash
+
+        raise NativeCrash("native CDCL library unavailable")
+    flat, total = _flatten(clauses)
+    model_buf = ctypes.create_string_buffer(max(1, n_vars))
+    status = lib.mtpu_solve(flat, total, n_vars, max_conflicts, model_buf,
+                            timeout_ms)
+    if status == SAT:
+        return SAT, [model_buf.raw[v] == 1 for v in range(n_vars)]
+    return status, None
+
+
+def solve_cnf_python(clauses: List[List[int]], n_vars: int,
+                     max_conflicts: int = 2_000_000
+                     ) -> Tuple[int, Optional[List[bool]]]:
+    """The unconditional ladder floor: pure-Python DPLL. Orders of magnitude
+    slower than the native core, but it cannot crash a worker and needs no
+    artifacts — it is never breaker-gated."""
+    return _python_dpll(clauses, n_vars, max_conflicts)
+
+
 def solve_cnf(clauses: List[List[int]], n_vars: int,
               max_conflicts: int = 2_000_000, timeout_ms: int = 0
               ) -> Tuple[int, Optional[List[bool]]]:
-    """Returns (status, model). model[v-1] is the boolean for DIMACS var v on SAT."""
-    lib = _load_lib()
-    if lib is not None:
-        flat, total = _flatten(clauses)
-        model_buf = ctypes.create_string_buffer(max(1, n_vars))
-        status = lib.mtpu_solve(flat, total, n_vars, max_conflicts, model_buf,
-                                timeout_ms)
-        if status == SAT:
-            return SAT, [model_buf.raw[v] == 1 for v in range(n_vars)]
-        return status, None
+    """Returns (status, model). model[v-1] is the boolean for DIMACS var v on SAT.
+
+    Degradation ladder (support/resilience.py): native CDCL when its circuit
+    breaker allows, pure-Python DPLL otherwise. A native failure is classified
+    and counted; `trip_after` consecutive failures trip the breaker and all
+    queries run on the Python floor until a recovery probe succeeds."""
+    import logging
+
+    from ...support import resilience
+
+    health = resilience.registry.backend(resilience.NATIVE)
+    if have_native() and health.allow():
+        try:
+            resilience.fire(resilience.NATIVE)
+            status, model = solve_cnf_native(clauses, n_vars, max_conflicts,
+                                             timeout_ms)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as error:
+            failure_class = (error.failure_class
+                             if isinstance(error, resilience.BackendFailure)
+                             else resilience.NATIVE_CRASH)
+            logging.getLogger(__name__).warning(
+                "native CDCL failed [%s] (%r) on %d clauses / %d vars — "
+                "degrading to the pure-Python DPLL", failure_class, error,
+                len(clauses), n_vars)
+            health.record_failure(failure_class, repr(error))
+        else:
+            health.record_success()
+            return status, model
     return _python_dpll(clauses, n_vars, max_conflicts)
 
 

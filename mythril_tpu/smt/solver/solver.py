@@ -32,29 +32,123 @@ from .solver_statistics import SolverStatistics, stat_smt_query
 CONFLICTS_PER_MS = 160
 
 
+def _model_satisfies(clauses, model) -> bool:
+    """Cheap host-side verification of a SAT model: every clause must have a
+    true literal under the assignment (model[v-1] is DIMACS var v)."""
+    for clause in clauses:
+        for lit in clause:
+            value = model[lit - 1] if lit > 0 else not model[-lit - 1]
+            if value:
+                break
+        else:
+            return False
+    return True
+
+
+def _crosscheck_device_verdict(clauses, n_vars, max_conflicts, status, model):
+    """Divergence quarantine (opt-in, `--device-crosscheck N`): re-decide a
+    sampled device verdict on the host — SAT models are verified directly
+    against the clauses, UNSAT claims replayed through the host CDCL oracle.
+    Any disagreement QUARANTINEs the device backend for the rest of the run
+    and the host's answer is returned instead. Returns (status, model)."""
+    from ...support import resilience
+
+    statistics = SolverStatistics()
+    injected = resilience.take("divergence")
+    every = resilience.crosscheck_every()
+    if not injected:
+        if not every or (statistics.device_solved + 1) % every != 0:
+            return status, model
+    else:
+        # simulate a wrong device verdict so the oracle path is exercised
+        # end-to-end: flip sat<->unsat (a bogus model would also be caught
+        # by the clause check below)
+        status = sat.UNSAT if status == sat.SAT else sat.SAT
+        model = None if status == sat.UNSAT else [False] * n_vars
+
+    statistics.crosschecks += 1
+    diverged = None  # detail string when the device verdict is disproven
+    host_status, host_model = status, model
+    if status == sat.SAT:
+        if model is None or not _model_satisfies(clauses, model):
+            diverged = "device SAT model does not satisfy the clauses"
+            host_status, host_model = sat.solve_cnf(clauses, n_vars,
+                                                    max_conflicts)
+    else:  # UNSAT claim: replay through the host oracle
+        host_status, host_model = sat.solve_cnf(clauses, n_vars,
+                                                max_conflicts)
+        if host_status == sat.SAT:
+            diverged = "device claimed UNSAT but host oracle found a model"
+        elif host_status == sat.UNKNOWN:
+            # oracle inconclusive: cannot confirm or refute — keep device
+            host_status, host_model = status, model
+
+    if diverged is None:
+        return host_status, host_model
+    statistics.divergences += 1
+    log.critical("device/host verdict DIVERGENCE on %d clauses / %d vars: "
+                 "%s — quarantining the device backend", len(clauses),
+                 n_vars, diverged)
+    resilience.registry.backend(resilience.DEVICE).record_failure(
+        resilience.DIVERGENCE, diverged)
+    return host_status, host_model
+
+
 def _device_solve(clauses, n_vars, max_conflicts):
     """The `--solver jax` lane (parallel/jax_solver.py): batched device DPLL
     with UNKNOWN on failure or oversize, so the caller falls back to the
     native CDCL. A device failure must never surface as "no issues": it is
-    logged and counted (SolverStatistics.device_fallbacks) — the analyzer's
-    crash salvage never sees it (VERDICT r2 weak #1)."""
+    classified (support/resilience.py), logged, and counted per failure
+    domain; `trip_after` consecutive failures trip the backend's circuit
+    breaker so a sick device stops paying XLA recompiles per query."""
     from ...parallel import jax_solver
+    from ...support import resilience
 
     statistics = SolverStatistics()
+    health = resilience.registry.backend(resilience.DEVICE)
+    if not health.allow():
+        statistics.device_skipped += 1
+        return jax_solver.UNKNOWN, None
     statistics.device_queries += 1
+    started = time.time()
     try:
+        resilience.fire(resilience.DEVICE)
         status, model = jax_solver.solve_cnf_device(
             clauses, n_vars, max_steps=min(max_conflicts, 50_000))
-    except Exception as error:  # device OOM / worker crash / trace error
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as error:  # classified below: OOM / compile / crash
+        failure_class = resilience.classify_failure(error)
         log.warning(
-            "device solver failed (%s: %s) on %d clauses / %d vars — "
-            "falling back to native CDCL", type(error).__name__, error,
+            "device solver failed [%s] (%r) on %d clauses / %d vars — "
+            "falling back to native CDCL", failure_class, error,
             len(clauses), n_vars)
-        status, model = jax_solver.UNKNOWN, None
+        health.record_failure(failure_class, repr(error))
+        statistics.device_fallbacks += 1
+        return jax_solver.UNKNOWN, None
+
+    # a sick backend often still answers — after minutes of recompile; a
+    # wall-clock overrun counts against its health even when the verdict is
+    # usable (the breaker exists to stop paying that latency per query)
+    overran = False
+    budget_ms = resilience.device_wall_budget_ms()
+    if budget_ms:
+        elapsed_ms = (time.time() - started) * 1000.0
+        if elapsed_ms > budget_ms:
+            overran = True
+            log.warning("device solve answered but took %.0f ms "
+                        "(budget %d ms) — recording wall_overrun",
+                        elapsed_ms, budget_ms)
+            health.record_failure(resilience.WALL_OVERRUN,
+                                  f"{elapsed_ms:.0f}ms")
     if status == jax_solver.UNKNOWN:
         statistics.device_fallbacks += 1
-    else:
-        statistics.device_solved += 1
+        return status, None
+    status, model = _crosscheck_device_verdict(clauses, n_vars,
+                                               max_conflicts, status, model)
+    if not overran:
+        health.record_success()
+    statistics.device_solved += 1
     return status, model
 
 
@@ -108,6 +202,11 @@ def reset_solver_backend() -> None:
     from ...core.time_handler import time_handler
 
     time_handler.reset()
+    # fresh backends + disarmed fault plan: breaker trips and quarantines
+    # belong to the analysis that suffered them, not the next one
+    from ...support import resilience
+
+    resilience.reset()
 
 
 def check_formulas(raw_constraints: List[terms.Term],

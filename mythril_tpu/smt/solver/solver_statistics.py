@@ -22,6 +22,7 @@ class SolverStatistics:
             cls._instance.device_fallbacks = 0
             cls._instance.device_solved = 0
             cls._instance._init_simplify()
+            cls._instance._init_resilience()
         return cls._instance
 
     def _init_simplify(self) -> None:
@@ -40,6 +41,19 @@ class SolverStatistics:
         #: post-simplification clause count of a specific query
         self.last_query_clauses = 0
 
+    def _init_resilience(self) -> None:
+        # failure domains + circuit breaker (support/resilience.py)
+        #: classified failures keyed "backend:class" (e.g. "device:device_oom")
+        self.failure_counts = {}
+        #: queries skipped because a backend's breaker was OPEN/QUARANTINED
+        self.device_skipped = 0
+        self.breaker_trips = 0
+        self.breaker_recoveries = 0
+        #: sampled device-verdict cross-checks against the host oracle
+        self.crosschecks = 0
+        self.divergences = 0
+        self.backends_quarantined = []
+
     def reset(self) -> None:
         self.query_count = 0
         self.solver_time = 0.0
@@ -47,6 +61,7 @@ class SolverStatistics:
         self.device_fallbacks = 0
         self.device_solved = 0
         self._init_simplify()
+        self._init_resilience()
 
     def __repr__(self):
         out = (f"Solver statistics: query count: {self.query_count}, "
@@ -65,6 +80,18 @@ class SolverStatistics:
                     f"{self.simplify_selects_bounded} bounded-selects, "
                     f"{self.simplify_extract_fusions} extract/concat, "
                     f"~{self.simplify_clauses_avoided} clauses avoided)")
+        if self.failure_counts or self.breaker_trips or self.device_skipped:
+            classified = ", ".join(f"{key}={count}" for key, count
+                                   in sorted(self.failure_counts.items()))
+            out += (f", failures: [{classified}]"
+                    f" (breaker trips: {self.breaker_trips}, "
+                    f"recoveries: {self.breaker_recoveries}, "
+                    f"queries skipped: {self.device_skipped})")
+        if self.crosschecks:
+            out += (f", crosschecks: {self.crosschecks} "
+                    f"(divergences: {self.divergences})")
+        if self.backends_quarantined:
+            out += f", QUARANTINED backends: {self.backends_quarantined}"
         return out
 
 

@@ -12,8 +12,9 @@ killed `analyze` to resume and emit the identical final report. GlobalStates
 are plain Python object graphs and the term DAG re-interns on unpickle
 (smt/terms.py Term.__reduce__), so pickle is sufficient and exact.
 
-Writes are atomic (tmp + os.replace): preemption mid-write never corrupts
-the only checkpoint.
+Writes are crash-safe (tmp + fsync + os.replace, then a best-effort
+directory fsync): preemption or power loss mid-write never corrupts the
+only checkpoint — either the old file or the complete new one survives.
 
 Known limit: laser-plugin INTERNAL state (e.g. the dependency pruner's
 per-iteration counters) is not serialized — a mid-transaction resume
@@ -35,6 +36,37 @@ log = logging.getLogger(__name__)
 FORMAT_VERSION = 1
 #: seconds between periodic mid-transaction saves
 SAVE_INTERVAL_S = 15.0
+#: states executed between periodic mid-transaction saves (overridable via
+#: MYTHRIL_TPU_CHECKPOINT_STATES; the time cadence still applies)
+SAVE_INTERVAL_STATES = 2000
+
+#: every key restore_into_laser dereferences — validated at load so a
+#: truncated or foreign payload degrades to a fresh run instead of raising
+#: a KeyError deep inside resume
+REQUIRED_KEYS = ("version", "tx_index", "open_states", "work_list",
+                 "executed_nodes", "total_states", "detectors")
+
+
+def fsync_replace(tmp: str, path: str) -> None:
+    """Durably promote `tmp` to `path`: flush the file's bytes to disk
+    before the rename, then best-effort fsync the directory so the rename
+    itself survives power loss (not just process death)."""
+    with open(tmp, "rb+") as handle:
+        os.fsync(handle.fileno())
+    os.replace(tmp, path)
+    try:
+        dir_fd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+    except OSError:
+        pass  # non-POSIX/odd filesystems: rename atomicity still holds
+
+
+def checkpoint_state_interval() -> int:
+    return int(os.environ.get("MYTHRIL_TPU_CHECKPOINT_STATES",
+                              SAVE_INTERVAL_STATES))
 
 
 def _collect_detector_state():
@@ -79,7 +111,7 @@ def save_host_checkpoint(path: str, laser, tx_index: int,
         tmp = f"{path}.tmp"
         with open(tmp, "wb") as handle:
             pickle.dump(payload, handle, protocol=4)
-        os.replace(tmp, path)
+        fsync_replace(tmp, path)
     finally:
         sys.setrecursionlimit(limit)
 
@@ -92,9 +124,18 @@ def load_host_checkpoint(path: str) -> Optional[dict]:
     try:
         with open(path, "rb") as handle:
             payload = pickle.load(handle)
+        if not isinstance(payload, dict):
+            log.warning("checkpoint %s is not a payload dict (%s); ignoring",
+                        path, type(payload).__name__)
+            return None
         if payload.get("version") != FORMAT_VERSION:
             log.warning("checkpoint %s has format %s (want %s); ignoring",
                         path, payload.get("version"), FORMAT_VERSION)
+            return None
+        missing = [key for key in REQUIRED_KEYS if key not in payload]
+        if missing:
+            log.warning("checkpoint %s is missing required keys %s; ignoring",
+                        path, missing)
             return None
         return payload
     except Exception as error:

@@ -58,7 +58,11 @@ class ModelCache:
                 if all(model.eval(c) for c in constraints):
                     self.model_cache.put(model, 1)
                     return model
-            except Exception:
+            except (KeyError, ValueError, TypeError):
+                # the probe is best-effort: KeyError = the model lacks a
+                # variable this conjunction mentions; ValueError/TypeError =
+                # the evaluator met a term it cannot fold. Anything else is
+                # a real bug and must surface.
                 continue
         return None
 
@@ -122,8 +126,8 @@ def get_model(constraints, minimize: Tuple = (), maximize: Tuple = (),
         try:
             if all(_ZERO_MODEL.eval(c) for c in raw_constraints):
                 return _ZERO_MODEL
-        except Exception:
-            pass
+        except (KeyError, ValueError, TypeError):
+            pass  # zero probe failed to evaluate: fall through to the solver
         hit = model_cache.check_quick_sat(raw_constraints)
         if hit is not None:
             return hit

@@ -1,0 +1,414 @@
+"""Failure domains, backend circuit breaker, and deterministic fault injection.
+
+The solver stack degrades along a fixed ladder — device DPLL (`--solver jax`)
+-> native CDCL -> pure-Python DPLL — and every rung decides the same
+sat/unsat question, so a degraded run produces the same issues as a healthy
+one, just slower (the DTVM determinism argument, PAPERS.md). This module
+gives that ladder real failure domains instead of one blanket
+`except Exception` counter:
+
+- **Failure taxonomy**: every backend failure is classified (`classify_failure`)
+  into one of `FAILURE_CLASSES` — device OOM, compile/trace error, wall-clock
+  overrun, worker crash, verdict divergence, native crash — and counted
+  per (backend, class) in `SolverStatistics.failure_counts`.
+- **Circuit breaker** (`BackendHealth`): a backend that fails
+  `trip_after` consecutive times is OPEN — skipped entirely, so a sick
+  device stops paying minutes of XLA recompile per query. After
+  `recovery_after` skipped queries one probe is let through (half-open);
+  a probe success CLOSEs the breaker, a probe failure re-arms the skip
+  window. A `DIVERGENCE` failure QUARANTINEs the backend for the rest of
+  the process (no recovery probes): a backend that returned a *wrong*
+  verdict can never be trusted again this run.
+- **Fault injection** (`configure` / `fire` / `take`): the
+  `--inject-fault CLASS[:NTH]` CLI flag (or `MYTHRIL_TPU_INJECT_FAULT`)
+  raises the typed exception for CLASS at the NTH visit of its boundary —
+  the device solve, the native solve, or the laser loop — so every ladder
+  rung, the breaker thresholds, and the checkpoint/resume path are
+  testable without real device failures.
+
+State is process-global (like `SolverStatistics`); `reset()` restores a
+pristine registry + plan and is called from
+`smt.solver.solver.reset_solver_backend` so each fresh analysis (or test)
+starts with healthy backends.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+# -- failure taxonomy -----------------------------------------------------------------
+
+#: device ran out of HBM / host memory while solving
+DEVICE_OOM = "device_oom"
+#: XLA compile / trace / lowering error (bad shapes, tracer leaks, ...)
+COMPILE_ERROR = "compile_error"
+#: the solve exceeded its wall-clock budget (e.g. a recompile storm)
+WALL_OVERRUN = "wall_overrun"
+#: device/worker process died or any other unclassified backend error
+WORKER_CRASH = "worker_crash"
+#: backend returned a sat/unsat verdict the host oracle disproves
+DIVERGENCE = "divergence"
+#: native CDCL library failure (load error, session corruption, crash)
+NATIVE_CRASH = "native_crash"
+#: injection-only: simulated kill of the host laser loop (exercises the
+#: checkpoint/resume path; never produced by classify_failure)
+HOST_CRASH = "host_crash"
+
+FAILURE_CLASSES = (DEVICE_OOM, COMPILE_ERROR, WALL_OVERRUN, WORKER_CRASH,
+                   DIVERGENCE, NATIVE_CRASH, HOST_CRASH)
+
+#: backend names in ladder order (PYTHON is the floor: never gated)
+DEVICE, NATIVE, PYTHON = "device", "native", "python"
+
+# breaker states
+CLOSED, OPEN, QUARANTINED = "closed", "open", "quarantined"
+
+
+class BackendFailure(Exception):
+    """Base of the typed failure exceptions (used by fault injection; real
+    backend errors keep their original type and are mapped by
+    classify_failure)."""
+
+    failure_class = WORKER_CRASH
+
+
+class DeviceOOM(BackendFailure):
+    failure_class = DEVICE_OOM
+
+
+class DeviceCompileError(BackendFailure):
+    failure_class = COMPILE_ERROR
+
+
+class DeviceWallOverrun(BackendFailure):
+    failure_class = WALL_OVERRUN
+
+
+class DeviceWorkerCrash(BackendFailure):
+    failure_class = WORKER_CRASH
+
+
+class NativeCrash(BackendFailure):
+    failure_class = NATIVE_CRASH
+
+
+class InjectedCrash(BaseException):
+    """Simulated kill -9 of the analysis loop (`--inject-fault host_crash:N`).
+    BaseException on purpose: it must sail through every `except Exception`
+    (the analyzer's per-contract catch-all included) and unwind like a real
+    death so the test can assert the run resumes from its last atomic
+    checkpoint."""
+
+    failure_class = HOST_CRASH
+
+
+_EXCEPTION_FOR_CLASS = {
+    DEVICE_OOM: DeviceOOM,
+    COMPILE_ERROR: DeviceCompileError,
+    WALL_OVERRUN: DeviceWallOverrun,
+    WORKER_CRASH: DeviceWorkerCrash,
+    NATIVE_CRASH: NativeCrash,
+    HOST_CRASH: InjectedCrash,
+}
+
+#: which injection boundary ("site") each failure class fires at
+SITE_OF_CLASS = {
+    DEVICE_OOM: DEVICE,
+    COMPILE_ERROR: DEVICE,
+    WALL_OVERRUN: DEVICE,
+    WORKER_CRASH: DEVICE,
+    DIVERGENCE: "divergence",
+    NATIVE_CRASH: NATIVE,
+    HOST_CRASH: "host",
+}
+
+#: substrings of exception type names / messages that identify OOMs. XLA
+#: surfaces device OOM as XlaRuntimeError("RESOURCE_EXHAUSTED: ...").
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "OOM",
+                "Resource exhausted")
+_COMPILE_TYPE_MARKERS = ("TracerError", "ConcretizationTypeError",
+                         "UnexpectedTracerError", "JaxStackTraceBeforeTransformation",
+                         "TypeError", "ShapeError")
+_COMPILE_MSG_MARKERS = ("INVALID_ARGUMENT", "compilation", "lowering",
+                        "abstract value", "jit")
+
+
+def classify_failure(error: BaseException) -> str:
+    """Map an arbitrary backend exception to a failure class. Typed
+    injection exceptions carry their class; real errors classify by type
+    and message shape, defaulting to WORKER_CRASH (the catch-all domain)."""
+    if isinstance(error, BackendFailure):
+        return error.failure_class
+    name = type(error).__name__
+    text = f"{name}: {error}"
+    if isinstance(error, MemoryError) or \
+            any(marker in text for marker in _OOM_MARKERS):
+        return DEVICE_OOM
+    if isinstance(error, TimeoutError):
+        return WALL_OVERRUN
+    if any(marker in name for marker in _COMPILE_TYPE_MARKERS) or \
+            any(marker in str(error) for marker in _COMPILE_MSG_MARKERS):
+        return COMPILE_ERROR
+    return WORKER_CRASH
+
+
+# -- circuit breaker ------------------------------------------------------------------
+
+#: consecutive failures before a backend trips OPEN
+DEFAULT_TRIP_AFTER = 3
+#: queries skipped while OPEN before one half-open recovery probe
+DEFAULT_RECOVERY_AFTER = 32
+
+
+def _stats():
+    from ..smt.solver.solver_statistics import SolverStatistics
+
+    return SolverStatistics()
+
+
+class BackendHealth:
+    """Per-backend failure bookkeeping + circuit breaker.
+
+    States: CLOSED (healthy, queries flow), OPEN (tripped: queries are
+    skipped, with a half-open probe every `recovery_after` skips),
+    QUARANTINED (divergence: permanently off for this run). Every
+    transition is mirrored into SolverStatistics so the final report can
+    show the full fault story."""
+
+    def __init__(self, name: str, trip_after: int = DEFAULT_TRIP_AFTER,
+                 recovery_after: int = DEFAULT_RECOVERY_AFTER):
+        self.name = name
+        self.trip_after = trip_after
+        self.recovery_after = recovery_after
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.skipped_since_trip = 0
+        self.failure_counts: Dict[str, int] = {}
+        self.trips = 0
+        self.recoveries = 0
+        self.last_failure: Optional[Tuple[str, str]] = None  # (class, detail)
+
+    def allow(self) -> bool:
+        """May the next query attempt this backend? OPEN breakers skip
+        queries but let one probe through per recovery window."""
+        if self.state == QUARANTINED:
+            return False
+        if self.state == OPEN:
+            self.skipped_since_trip += 1
+            if self.skipped_since_trip >= self.recovery_after:
+                log.info("backend %r half-open: letting a recovery probe "
+                         "through after %d skipped queries", self.name,
+                         self.skipped_since_trip)
+                return True
+            return False
+        return True
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        if self.state == OPEN:
+            # a successful half-open probe recovers the backend
+            self.state = CLOSED
+            self.skipped_since_trip = 0
+            self.recoveries += 1
+            _stats().breaker_recoveries += 1
+            log.warning("backend %r recovered: circuit breaker closed",
+                        self.name)
+
+    def record_failure(self, failure_class: str, detail: str = "") -> None:
+        self.failure_counts[failure_class] = \
+            self.failure_counts.get(failure_class, 0) + 1
+        self.consecutive_failures += 1
+        self.last_failure = (failure_class, detail)
+        stats = _stats()
+        key = f"{self.name}:{failure_class}"
+        stats.failure_counts[key] = stats.failure_counts.get(key, 0) + 1
+        if failure_class == DIVERGENCE:
+            self.quarantine(detail)
+            return
+        if self.state == OPEN:
+            # failed recovery probe: re-arm the skip window
+            self.skipped_since_trip = 0
+            return
+        if self.state == CLOSED and \
+                self.consecutive_failures >= self.trip_after:
+            self.state = OPEN
+            self.skipped_since_trip = 0
+            self.trips += 1
+            stats.breaker_trips += 1
+            log.error(
+                "backend %r circuit breaker TRIPPED after %d consecutive "
+                "failures (last: %s %s) — degrading to the next ladder rung",
+                self.name, self.consecutive_failures, failure_class, detail)
+
+    def quarantine(self, detail: str = "") -> None:
+        """Permanently disable the backend for this run (divergence: a
+        backend that produced a wrong verdict cannot be probed back)."""
+        if self.state == QUARANTINED:
+            return
+        self.state = QUARANTINED
+        stats = _stats()
+        if self.name not in stats.backends_quarantined:
+            stats.backends_quarantined.append(self.name)
+        log.critical(
+            "backend %r QUARANTINED for the rest of this run: %s — all "
+            "further queries use the host ladder", self.name,
+            detail or "verdict divergence")
+
+
+class HealthRegistry:
+    """Process-wide registry of BackendHealth objects (DEVICE / NATIVE;
+    PYTHON is the unconditional floor and is never registered)."""
+
+    def __init__(self):
+        self._backends: Dict[str, BackendHealth] = {}
+
+    def backend(self, name: str) -> BackendHealth:
+        health = self._backends.get(name)
+        if health is None:
+            trip = int(os.environ.get("MYTHRIL_TPU_BREAKER_TRIP",
+                                      DEFAULT_TRIP_AFTER))
+            recover = int(os.environ.get("MYTHRIL_TPU_BREAKER_RECOVERY",
+                                         DEFAULT_RECOVERY_AFTER))
+            health = BackendHealth(name, trip_after=trip,
+                                   recovery_after=recover)
+            self._backends[name] = health
+        return health
+
+    def states(self) -> Dict[str, str]:
+        return {name: health.state
+                for name, health in sorted(self._backends.items())}
+
+    def reset(self) -> None:
+        self._backends.clear()
+
+
+registry = HealthRegistry()
+
+
+# -- deterministic fault injection ----------------------------------------------------
+
+
+def _parse_matcher(spec: str) -> Callable[[int], bool]:
+    """"3" fires exactly at visit 3, "3+" from visit 3 on, "*" at every
+    visit; an omitted NTH means "1"."""
+    spec = spec.strip() or "1"
+    if spec == "*":
+        return lambda count: True
+    if spec.endswith("+"):
+        nth = int(spec[:-1])
+        return lambda count: count >= nth
+    nth = int(spec)
+    return lambda count: count == nth
+
+
+class FaultPlan:
+    """Parsed `--inject-fault` spec: comma-separated CLASS[:NTH] entries.
+    Each boundary visit increments a per-site counter; an entry fires when
+    its matcher accepts the count — fully deterministic, no clocks."""
+
+    def __init__(self, spec: Optional[str] = None):
+        self.spec = spec
+        #: (failure_class, site, matcher)
+        self.entries: List[Tuple[str, str, Callable[[int], bool]]] = []
+        self.site_counts: Dict[str, int] = {}
+        self.fired: List[Tuple[str, int]] = []  # (class, visit) audit trail
+        for raw_entry in (spec or "").split(","):
+            raw_entry = raw_entry.strip()
+            if not raw_entry:
+                continue
+            failure_class, _, nth = raw_entry.partition(":")
+            failure_class = failure_class.strip()
+            if failure_class not in SITE_OF_CLASS:
+                raise ValueError(
+                    f"unknown fault class {failure_class!r}; expected one of "
+                    f"{sorted(SITE_OF_CLASS)}")
+            self.entries.append((failure_class, SITE_OF_CLASS[failure_class],
+                                 _parse_matcher(nth)))
+
+    @property
+    def active(self) -> bool:
+        return bool(self.entries)
+
+    def visit(self, site: str) -> Optional[str]:
+        """Record a boundary visit; returns the failure class to fire (or
+        None). At most one entry fires per visit (first match wins)."""
+        if not self.entries:
+            return None
+        count = self.site_counts.get(site, 0) + 1
+        self.site_counts[site] = count
+        for failure_class, entry_site, matcher in self.entries:
+            if entry_site == site and matcher(count):
+                self.fired.append((failure_class, count))
+                return failure_class
+        return None
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def configure(spec: Optional[str]) -> None:
+    """Install a fault plan (None/empty disables injection). Also resets
+    the plan's visit counters — each configure starts a fresh schedule."""
+    global _plan
+    _plan = FaultPlan(spec)
+    if _plan.active:
+        log.warning("fault injection ACTIVE: %s", spec)
+
+
+def plan() -> FaultPlan:
+    global _plan
+    if _plan is None:
+        _plan = FaultPlan(os.environ.get("MYTHRIL_TPU_INJECT_FAULT"))
+        if _plan.active:
+            log.warning("fault injection ACTIVE (env): %s", _plan.spec)
+    return _plan
+
+
+def fire(site: str) -> None:
+    """Raise the configured typed exception if an entry matches this visit
+    of `site`. No-op (one dict lookup) when injection is inactive."""
+    failure_class = plan().visit(site)
+    if failure_class is not None:
+        raise _EXCEPTION_FOR_CLASS[failure_class](
+            f"injected {failure_class} (visit "
+            f"{plan().site_counts[site]} of site {site!r})")
+
+
+def take(site: str) -> bool:
+    """Non-raising variant for verdict-mutation classes (divergence):
+    True when this visit should fire."""
+    return plan().visit(site) is not None
+
+
+# -- knobs read by the solver stack ---------------------------------------------------
+
+
+def device_wall_budget_ms() -> int:
+    """Wall-clock budget for one device solve before it counts as a
+    WALL_OVERRUN failure (0 disables the check). A sick backend often
+    still answers — after minutes of recompile; overruns trip the breaker
+    even when the verdict is usable."""
+    return int(os.environ.get("MYTHRIL_TPU_DEVICE_WALL_MS", 120_000))
+
+
+def crosscheck_every() -> int:
+    """Sampling period for the divergence cross-check: every Nth device
+    verdict is re-decided by the host CDCL oracle (0 = off, the default).
+    Set by `--device-crosscheck N` or MYTHRIL_TPU_CROSSCHECK."""
+    from .support_args import args
+
+    configured = getattr(args, "device_crosscheck", 0)
+    if configured:
+        return int(configured)
+    return int(os.environ.get("MYTHRIL_TPU_CROSSCHECK", 0))
+
+
+def reset() -> None:
+    """Fresh registry + disarmed plan (per-analysis / per-test isolation)."""
+    global _plan
+    registry.reset()
+    _plan = FaultPlan(None)

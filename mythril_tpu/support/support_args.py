@@ -43,6 +43,12 @@ class Args:
         self.sparse_pruning = True
         self.enable_state_merging = False
         self.enable_summaries = False
+        #: deterministic fault injection spec, `CLASS[:NTH],...`
+        #: (support/resilience.py; --inject-fault / MYTHRIL_TPU_INJECT_FAULT)
+        self.inject_fault = None
+        #: cross-check every Nth device verdict against the host CDCL oracle
+        #: (0 = off); a divergence quarantines the device backend for the run
+        self.device_crosscheck = 0
 
 
 args = Args()

@@ -1,0 +1,389 @@
+"""Failure taxonomy, circuit breaker, degradation ladder, fault injection,
+and crash-safe checkpoint/resume (ISSUE 2).
+
+Ladder coverage never runs a real device solve (the jax DPLL pays minutes of
+XLA compile per clause shape): `solve_cnf_device` is monkeypatched at the
+module attribute, and device failures are produced by the deterministic
+fault plan (`--inject-fault CLASS[:NTH]`) firing at the exact boundaries the
+production code guards."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from mythril_tpu.smt.solver import sat
+from mythril_tpu.smt.solver import solver as solver_module
+from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+from mythril_tpu.support import resilience
+from mythril_tpu.support.support_args import args
+
+#: (clauses, n_vars, expected) decision fixtures exercised on every rung
+SAT_CNF = ([[1, 2], [-1], [2]], 2, sat.SAT)
+UNSAT_CNF = ([[1], [-1]], 1, sat.UNSAT)
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    resilience.reset()
+    SolverStatistics().reset()
+    monkeypatch.setattr(args, "device_crosscheck", 0)
+    yield
+    resilience.reset()
+    SolverStatistics().reset()
+
+
+# -- taxonomy -------------------------------------------------------------------------
+
+
+def test_classify_failure_taxonomy():
+    assert resilience.classify_failure(resilience.DeviceOOM("x")) == \
+        resilience.DEVICE_OOM
+    assert resilience.classify_failure(MemoryError()) == resilience.DEVICE_OOM
+    assert resilience.classify_failure(
+        RuntimeError("RESOURCE_EXHAUSTED: hbm allocator")) == \
+        resilience.DEVICE_OOM
+    assert resilience.classify_failure(TimeoutError()) == \
+        resilience.WALL_OVERRUN
+
+    class UnexpectedTracerError(Exception):
+        pass
+
+    assert resilience.classify_failure(UnexpectedTracerError("leak")) == \
+        resilience.COMPILE_ERROR
+    assert resilience.classify_failure(
+        RuntimeError("INVALID_ARGUMENT: bad shape")) == \
+        resilience.COMPILE_ERROR
+    assert resilience.classify_failure(RuntimeError("boom")) == \
+        resilience.WORKER_CRASH
+
+
+# -- fault plan -----------------------------------------------------------------------
+
+
+def test_fault_plan_nth_semantics():
+    plan = resilience.FaultPlan("device_oom:3")
+    assert [plan.visit("device") for _ in range(4)] == \
+        [None, None, resilience.DEVICE_OOM, None]
+
+    plan = resilience.FaultPlan("native_crash:2+")
+    assert [plan.visit("native") for _ in range(4)] == \
+        [None] + [resilience.NATIVE_CRASH] * 3
+
+    plan = resilience.FaultPlan("divergence:*")
+    assert all(plan.visit("divergence") == resilience.DIVERGENCE
+               for _ in range(3))
+
+    # default NTH is 1; entries are per-site, other sites never fire
+    plan = resilience.FaultPlan("device_oom")
+    assert plan.visit("native") is None
+    assert plan.visit("device") == resilience.DEVICE_OOM
+
+
+def test_fault_plan_rejects_unknown_class():
+    with pytest.raises(ValueError):
+        resilience.FaultPlan("segfault:1")
+
+
+def test_fire_raises_typed_exception():
+    resilience.configure("compile_error:1")
+    with pytest.raises(resilience.DeviceCompileError):
+        resilience.fire("device")
+    resilience.fire("device")  # visit 2: disarmed
+
+
+# -- circuit breaker ------------------------------------------------------------------
+
+
+def test_breaker_trips_recovers_and_counts():
+    health = resilience.BackendHealth("device", trip_after=3,
+                                      recovery_after=4)
+    stats = SolverStatistics()
+    for _ in range(2):
+        health.record_failure(resilience.DEVICE_OOM, "e")
+    assert health.state == resilience.CLOSED
+    health.record_failure(resilience.DEVICE_OOM, "e")
+    assert health.state == resilience.OPEN
+    assert stats.breaker_trips == 1
+    assert stats.failure_counts == {"device:device_oom": 3}
+
+    # OPEN skips queries until the recovery window elapses, then lets one
+    # half-open probe through
+    assert [health.allow() for _ in range(4)] == [False, False, False, True]
+    health.record_success()
+    assert health.state == resilience.CLOSED
+    assert stats.breaker_recoveries == 1
+
+    # a success resets the consecutive-failure count
+    health.record_failure(resilience.DEVICE_OOM, "e")
+    health.record_success()
+    for _ in range(2):
+        health.record_failure(resilience.DEVICE_OOM, "e")
+    assert health.state == resilience.CLOSED
+
+
+def test_failed_recovery_probe_rearms_skip_window():
+    health = resilience.BackendHealth("device", trip_after=1,
+                                      recovery_after=3)
+    health.record_failure(resilience.WORKER_CRASH, "e")
+    assert health.state == resilience.OPEN
+    assert [health.allow() for _ in range(3)] == [False, False, True]
+    health.record_failure(resilience.WORKER_CRASH, "probe failed")
+    assert health.state == resilience.OPEN
+    # the window restarts: two skips again before the next probe
+    assert [health.allow() for _ in range(3)] == [False, False, True]
+
+
+def test_divergence_quarantines_permanently():
+    health = resilience.BackendHealth("device", trip_after=3)
+    health.record_failure(resilience.DIVERGENCE, "wrong verdict")
+    assert health.state == resilience.QUARANTINED
+    assert not health.allow()
+    health.record_success()  # no resurrection path
+    assert health.state == resilience.QUARANTINED
+    assert SolverStatistics().backends_quarantined == ["device"]
+
+
+# -- degradation ladder: identical verdicts on every rung -----------------------------
+
+
+def test_python_floor_verdicts():
+    for clauses, n_vars, expected in (SAT_CNF, UNSAT_CNF):
+        status, model = sat.solve_cnf_python(clauses, n_vars)
+        assert status == expected
+        if status == sat.SAT:
+            assert all(any((lit > 0) == model[abs(lit) - 1] for lit in cl)
+                       for cl in clauses)
+
+
+@pytest.mark.skipif(not sat.have_native(),
+                    reason="native CDCL build required")
+def test_native_rung_matches_python_floor():
+    for clauses, n_vars, expected in (SAT_CNF, UNSAT_CNF):
+        assert sat.solve_cnf_native(clauses, n_vars)[0] == expected
+
+
+def test_native_failure_degrades_to_python_same_verdict():
+    """native_crash injection at the native boundary: solve_cnf still
+    returns the correct verdict (python floor), the failure is classified,
+    and the breaker trips after trip_after consecutive failures."""
+    resilience.configure("native_crash:*")
+    for clauses, n_vars, expected in (SAT_CNF, UNSAT_CNF, SAT_CNF):
+        assert sat.solve_cnf(clauses, n_vars)[0] == expected
+    stats = SolverStatistics()
+    if sat.have_native():
+        # 3 consecutive native failures == DEFAULT_TRIP_AFTER: breaker OPEN
+        assert stats.failure_counts["native:native_crash"] == 3
+        assert resilience.registry.backend(resilience.NATIVE).state == \
+            resilience.OPEN
+        # while OPEN the native boundary is not even visited: the plan's
+        # site counter stays put and verdicts keep coming from the floor
+        visits = resilience.plan().site_counts.get("native")
+        assert sat.solve_cnf(*SAT_CNF[:2])[0] == sat.SAT
+        assert resilience.plan().site_counts.get("native") == visits
+
+
+def test_device_rung_matches_host_verdicts(monkeypatch):
+    """A healthy (simulated) device yields the same verdicts as the host
+    rungs. The device function is monkeypatched to decide with the python
+    solver — never a real device solve in tier-1."""
+    from mythril_tpu.parallel import jax_solver
+
+    monkeypatch.setattr(
+        jax_solver, "solve_cnf_device",
+        lambda clauses, n_vars, **kw: sat.solve_cnf_python(clauses, n_vars))
+    for clauses, n_vars, expected in (SAT_CNF, UNSAT_CNF):
+        assert solver_module._device_solve(clauses, n_vars, 10_000)[0] == \
+            expected
+    stats = SolverStatistics()
+    assert stats.device_solved == 2
+    assert stats.failure_counts == {}
+
+
+def test_device_failure_classified_then_breaker_skips(monkeypatch):
+    calls = []
+
+    def exploding_device(clauses, n_vars, **kw):
+        calls.append(1)
+        raise MemoryError("hbm oom")
+
+    from mythril_tpu.parallel import jax_solver
+
+    monkeypatch.setattr(jax_solver, "solve_cnf_device", exploding_device)
+    stats = SolverStatistics()
+    for _ in range(resilience.DEFAULT_TRIP_AFTER):
+        status, _ = solver_module._device_solve(*SAT_CNF[:2], 10_000)
+        assert status == sat.UNKNOWN  # caller falls back to the host ladder
+    assert stats.failure_counts == {
+        "device:device_oom": resilience.DEFAULT_TRIP_AFTER}
+    assert resilience.registry.backend(resilience.DEVICE).state == \
+        resilience.OPEN
+    # breaker OPEN: the device function is no longer even called
+    before = len(calls)
+    assert solver_module._device_solve(*SAT_CNF[:2], 10_000)[0] == \
+        sat.UNKNOWN
+    assert len(calls) == before
+    assert stats.device_skipped == 1
+
+
+def test_device_divergence_quarantined_host_verdict_wins(monkeypatch):
+    """Injected divergence flips the device verdict; the sampled cross-check
+    disproves it against the host oracle, quarantines the backend for the
+    run, and returns the HOST verdict."""
+    from mythril_tpu.parallel import jax_solver
+
+    monkeypatch.setattr(
+        jax_solver, "solve_cnf_device",
+        lambda clauses, n_vars, **kw: sat.solve_cnf_python(clauses, n_vars))
+    resilience.configure("divergence:1")
+    clauses, n_vars, _ = SAT_CNF
+    status, model = solver_module._device_solve(clauses, n_vars, 10_000)
+    assert status == sat.SAT  # the host oracle's answer, not the flipped one
+    assert model is not None
+    stats = SolverStatistics()
+    assert stats.divergences == 1
+    assert stats.backends_quarantined == ["device"]
+    assert resilience.registry.backend(resilience.DEVICE).state == \
+        resilience.QUARANTINED
+    # quarantine is permanent for the run: next query is skipped outright
+    assert solver_module._device_solve(clauses, n_vars, 10_000)[0] == \
+        sat.UNKNOWN
+    assert stats.device_skipped == 1
+
+
+def test_sampled_crosscheck_passes_healthy_device(monkeypatch):
+    from mythril_tpu.parallel import jax_solver
+
+    monkeypatch.setattr(
+        jax_solver, "solve_cnf_device",
+        lambda clauses, n_vars, **kw: sat.solve_cnf_python(clauses, n_vars))
+    monkeypatch.setattr(args, "device_crosscheck", 1)
+    for clauses, n_vars, expected in (SAT_CNF, UNSAT_CNF):
+        assert solver_module._device_solve(clauses, n_vars, 10_000)[0] == \
+            expected
+    stats = SolverStatistics()
+    assert stats.crosschecks == 2
+    assert stats.divergences == 0
+    assert resilience.registry.backend(resilience.DEVICE).state == \
+        resilience.CLOSED
+
+
+# -- checkpoint payload validation (satellite) ----------------------------------------
+
+
+def test_load_checkpoint_rejects_missing_keys(tmp_path):
+    import pickle
+
+    from mythril_tpu.support import checkpoint as cp
+
+    path = tmp_path / "truncated.ckpt"
+    with open(path, "wb") as handle:
+        pickle.dump({"version": cp.FORMAT_VERSION, "tx_index": 1}, handle)
+    assert cp.load_host_checkpoint(str(path)) is None
+
+    with open(path, "wb") as handle:
+        pickle.dump(["not", "a", "dict"], handle)
+    assert cp.load_host_checkpoint(str(path)) is None
+
+    with open(path, "wb") as handle:
+        pickle.dump({"version": cp.FORMAT_VERSION + 1}, handle)
+    assert cp.load_host_checkpoint(str(path)) is None
+
+
+def test_fsync_replace_promotes_atomically(tmp_path):
+    from mythril_tpu.support.checkpoint import fsync_replace
+
+    target = tmp_path / "ckpt.bin"
+    target.write_bytes(b"old")
+    tmp = tmp_path / "ckpt.bin.tmp"
+    tmp.write_bytes(b"new")
+    fsync_replace(str(tmp), str(target))
+    assert target.read_bytes() == b"new"
+    assert not tmp.exists()
+
+
+# -- acceptance: analysis-level ladder + kill/resume ----------------------------------
+
+pytestmark_e2e = pytest.mark.skipif(not sat.have_native(),
+                                    reason="native CDCL build required")
+
+
+def _analyze(tx_count, modules, checkpoint=None, resume=None,
+             tx_strategy=None):
+    from mythril_tpu.analysis.security import (fire_lasers,
+                                               reset_callback_modules)
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.frontends.asm import (assemble, creation_wrapper,
+                                           dispatcher)
+    from test_analysis import KILLBILLY
+
+    reset_callback_modules()
+    creation = creation_wrapper(assemble(dispatcher(KILLBILLY)))
+    wrapper = SymExecWrapper(
+        creation.hex(), address=None, strategy="bfs", max_depth=128,
+        execution_timeout=240, create_timeout=30,
+        transaction_count=tx_count, modules=modules,
+        compulsory_statespace=False, checkpoint_path=checkpoint,
+        resume_path=resume)
+    return fire_lasers(wrapper, white_list=modules)
+
+
+@pytestmark_e2e
+def test_inject_device_oom_analysis_completes_via_host_ladder(monkeypatch):
+    """ISSUE 2 acceptance: with --inject-fault device_oom:1 on the jax
+    solver lane, the analysis completes with the correct issues through the
+    host ladder and SolverStatistics records exactly one classified failure
+    with the breaker still CLOSED (1 < trip_after)."""
+    from mythril_tpu.parallel import jax_solver
+
+    # after the injected failure, the remaining device queries answer
+    # UNKNOWN (oversize-style fallback) — never a real device solve
+    monkeypatch.setattr(jax_solver, "solve_cnf_device",
+                        lambda clauses, n_vars, **kw: (jax_solver.UNKNOWN,
+                                                       None))
+    modules = ["AccidentallyKillable"]
+    baseline = _analyze(2, modules)
+    assert sorted(i.swc_id for i in baseline) == ["106"]
+
+    SolverStatistics().reset()
+    resilience.reset()
+    monkeypatch.setattr(args, "solver", "jax")
+    resilience.configure("device_oom:1")
+    injected = _analyze(2, modules)
+    assert sorted(i.swc_id for i in injected) == ["106"]
+    assert injected[0].transaction_sequence["steps"][-1]["input"] == \
+        baseline[0].transaction_sequence["steps"][-1]["input"]
+
+    stats = SolverStatistics()
+    assert stats.failure_counts == {"device:device_oom": 1}
+    assert resilience.registry.backend(resilience.DEVICE).state == \
+        resilience.CLOSED
+    assert stats.breaker_trips == 0
+
+
+@pytestmark_e2e
+def test_killed_run_resumes_from_atomic_checkpoint(monkeypatch, tmp_path):
+    """ISSUE 2 acceptance: a run killed mid-transaction (host_crash
+    injection — the deterministic kill -9) resumes from its last atomic
+    checkpoint to the same issue set as an uninterrupted run."""
+    modules = ["AccidentallyKillable"]
+    full = _analyze(2, modules)
+    assert sorted(i.swc_id for i in full) == ["106"]
+
+    # checkpoint every 5 popped states, die at the 13th: the 10-state
+    # checkpoint is on disk when the "kill" lands mid-worklist
+    monkeypatch.setenv("MYTHRIL_TPU_CHECKPOINT_STATES", "5")
+    ckpt = str(tmp_path / "killed.ckpt")
+    resilience.configure("host_crash:13")
+    with pytest.raises(resilience.InjectedCrash):
+        _analyze(2, modules, checkpoint=ckpt)
+    assert os.path.exists(ckpt)
+    assert not os.path.exists(ckpt + ".tmp")  # atomic: no torn temp file
+
+    resilience.configure(None)  # the resumed process has no fault plan
+    resumed = _analyze(2, modules, resume=ckpt)
+    assert sorted(i.swc_id for i in resumed) == ["106"]
+    assert resumed[0].transaction_sequence["steps"][-1]["input"] == \
+        full[0].transaction_sequence["steps"][-1]["input"]

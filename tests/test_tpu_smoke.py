@@ -30,10 +30,15 @@ def _run_on_tpu(snippet: str, timeout: int = 420) -> dict:
     # sitecustomize on the existing path (overwriting it silently demotes
     # the subprocess to CPU)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    probe = subprocess.run(
-        [sys.executable, "-c",
-         "import jax; print(jax.devices()[0].platform)"],
-        env=env, capture_output=True, text=True, timeout=120)
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            env=env, capture_output=True, text=True, timeout=120)
+    except subprocess.TimeoutExpired:
+        # libtpu hanging on instance-metadata fetch IS "no TPU reachable":
+        # the probe runs no repo code, so a hang here says nothing about us
+        pytest.skip("TPU platform probe hung (no reachable TPU)")
     if "tpu" not in probe.stdout:
         pytest.skip(f"no TPU platform visible: {probe.stdout!r}")
     result = subprocess.run([sys.executable, "-c", snippet], env=env,

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Lint: no new silent blanket exception swallows in the solver/device stack.
+
+Scans `mythril_tpu/smt/` and `mythril_tpu/parallel/` for `except` handlers
+that are BOTH broad (bare `except:`, `except Exception:`, or
+`except BaseException:`) AND silent (a body of only `pass`/`continue`/`...`).
+A handler like that erases the entire failure story the resilience subsystem
+exists to tell (support/resilience.py: every backend failure must be
+classified, logged, and counted) — it is exactly the pattern ISSUE 2
+replaced at smt/solver/solver.py:48.
+
+Audited survivors live in ALLOWLIST, keyed (file, enclosing def): sites
+where swallowing is the correct behavior (e.g. a __del__ finalizer, where
+raising during interpreter teardown is worse than any leak). Add a new
+entry only with a comment defending it.
+
+Run directly (`python tools/check_excepts.py`) or via the tier-1 suite
+(tests/test_lint_excepts.py). Exit status 1 on violations.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Optional, Tuple
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+#: directories whose every .py file is linted (repo-relative)
+SCAN_DIRS = ("mythril_tpu/smt", "mythril_tpu/parallel")
+
+#: audited (repo-relative path, enclosing function name) pairs
+ALLOWLIST = {
+    # finalizer: raising inside __del__ during interpreter shutdown turns a
+    # leak into a spurious stderr traceback; close() is the loud path
+    ("mythril_tpu/smt/solver/sat.py", "__del__"),
+    # optional on-disk kernel cache: jax versions without a compilation
+    # cache (or read-only home dirs) must not break import of the package
+    ("mythril_tpu/parallel/__init__.py", "_enable_persistent_cache"),
+}
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    node = handler.type
+    if node is None:
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in _BROAD
+    if isinstance(node, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in node.elts)
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    return all(isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue)
+               or (isinstance(stmt, ast.Expr)
+                   and isinstance(stmt.value, ast.Constant)
+                   and stmt.value.value is Ellipsis)
+               for stmt in handler.body)
+
+
+def _enclosing_function(tree: ast.AST, target: ast.ExceptHandler
+                        ) -> Optional[str]:
+    """Name of the innermost def/async def containing `target` (module
+    level -> None)."""
+    found: List[Optional[str]] = [None]
+
+    def descend(node: ast.AST, current: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if child is target:
+                found[0] = current
+                return
+            name = current
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = child.name
+            descend(child, name)
+
+    descend(tree, None)
+    return found[0]
+
+
+def check_file(path: str) -> List[Tuple[str, int, str]]:
+    """Returns violations as (relpath, lineno, detail)."""
+    relpath = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+    with open(path, "r", encoding="utf-8") as handle:
+        tree = ast.parse(handle.read(), filename=path)
+    violations = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not (_is_broad(node) and _is_silent(node)):
+            continue
+        function = _enclosing_function(tree, node)
+        if (relpath, function) in ALLOWLIST:
+            continue
+        where = function or "<module>"
+        violations.append((
+            relpath, node.lineno,
+            f"silent blanket except in {where}() — classify and log the "
+            "failure (support/resilience.py) or narrow the except; "
+            "allowlist in tools/check_excepts.py only with justification"))
+    return violations
+
+
+def run() -> List[Tuple[str, int, str]]:
+    violations = []
+    for scan_dir in SCAN_DIRS:
+        base = os.path.join(REPO_ROOT, scan_dir)
+        for dirpath, _, filenames in os.walk(base):
+            for filename in sorted(filenames):
+                if filename.endswith(".py"):
+                    violations.extend(
+                        check_file(os.path.join(dirpath, filename)))
+    return violations
+
+
+def main() -> int:
+    violations = run()
+    for relpath, lineno, detail in violations:
+        print(f"{relpath}:{lineno}: {detail}")
+    if violations:
+        print(f"\n{len(violations)} silent blanket except(s) found")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
