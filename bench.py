@@ -66,6 +66,13 @@ def _corpus_extras():
                 "total_swc_findings": data.get("total_swc_findings"),
                 "budget_s": data.get("budget_s"),
             }
+            # batched device SAT dispatch rollup (occupancy, cache hit
+            # rate, buckets compiled, amortized latency) — present when
+            # the sweep ran with --solver jax (measure_corpus.py writes it
+            # from SolverStatistics.batch_metrics) so BENCH_r06+ tracks
+            # amortization, not just states/s
+            if data.get("solver_batch") is not None:
+                extras[engine]["solver_batch"] = data["solver_batch"]
     return extras
 
 

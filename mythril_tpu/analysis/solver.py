@@ -19,8 +19,8 @@ from ..core.transaction.transaction_models import (BaseTransaction,
                                                    ContractCreationTransaction)
 from ..core.transaction.symbolic import ACTORS
 from ..exceptions import UnsatError
-from ..smt import Bool, UGE, ULE, symbol_factory
-from ..support.model import get_model
+from ..smt import Bool, UGE, ULE, symbol_factory, terms
+from ..support.model import get_model, prefetch_models
 
 log = logging.getLogger(__name__)
 
@@ -41,6 +41,20 @@ def get_transaction_sequence(global_state, constraints) -> Dict:
 
     tx_constraints, minimize = _set_minimisation_constraints(
         transaction_sequence, list(constraints), [], 5000, global_state.world_state)
+
+    # issue-confirmation prefetch (`--solver jax` + batching, no-op
+    # otherwise): queue the base feasibility query together with the
+    # Optimize extreme-probe ladder (every minimized objective pinned to 0
+    # — the overwhelmingly common witness) so the whole confirmation
+    # sequence solves as one device batch instead of a launch per probe
+    speculative = [tuple(tx_constraints)]
+    pinned = []
+    for objective in minimize:
+        raw = objective.raw if hasattr(objective, "raw") else objective
+        pinned.append(Bool(terms.bv_cmp(
+            "eq", raw, terms.bv_const(0, raw.width))))
+        speculative.append(tuple(tx_constraints) + tuple(pinned))
+    prefetch_models(speculative)
 
     try:
         model = get_model(tuple(tx_constraints), minimize=tuple(minimize))

@@ -63,6 +63,14 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
     options.add_argument("--no-simplify", action="store_true",
                          help="disable the word-level simplification pass "
                               "ahead of the bit-blaster (A/B measurement)")
+    options.add_argument("--no-batch-solve", action="store_true",
+                         help="disable the batched device SAT dispatch "
+                              "(smt/solver/dispatch.py): every --solver jax "
+                              "query pays its own device launch, no verdict "
+                              "cache (A/B measurement); flush thresholds "
+                              "tune via MYTHRIL_TPU_BATCH_FLUSH / "
+                              "MYTHRIL_TPU_BATCH_AGE_MS / "
+                              "MYTHRIL_TPU_VERDICT_CACHE")
     options.add_argument("--engine", default="host", choices=["host", "tpu"],
                          help="exploration engine: host worklist or the "
                               "batched TPU symbolic frontier")

@@ -59,6 +59,7 @@ class MythrilAnalyzer:
         args.enable_state_merging = getattr(cmd, "enable_state_merging", False)
         args.enable_summaries = getattr(cmd, "enable_summaries", False)
         args.simplify = not getattr(cmd, "no_simplify", False)
+        args.batch_solve = not getattr(cmd, "no_batch_solve", False)
         args.device_crosscheck = getattr(cmd, "device_crosscheck", 0)
         args.inject_fault = getattr(cmd, "inject_fault", None)
         solver = getattr(cmd, "solver", None)

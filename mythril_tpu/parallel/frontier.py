@@ -689,6 +689,8 @@ class _Frontier:
                     while self.deferred:
                         entry = self.deferred[0]
                         rows_state, rows_planes, count, _ = entry
+                        self._prefetch_feasibility(rows_planes,
+                                                   range(entry[3], count))
                         while entry[3] < count:
                             # advance the cursor in place BEFORE popping: a
                             # mid-loop exception must leave the entry (with
@@ -978,6 +980,8 @@ class _Frontier:
                 entry = self.deferred[0]
                 rows_state, rows_planes, count, cursor = entry
                 take = min(count - cursor, batch_rows - fed)
+                self._prefetch_feasibility(rows_planes,
+                                           range(cursor, cursor + take))
                 for row in range(cursor, cursor + take):
                     self._materialize_np(rows_state, rows_planes,
                                          self.harena, row)
@@ -1115,6 +1119,38 @@ class _Frontier:
                 memo[key] = cached
             bools.append(cached)
         return bools
+
+    def _prefetch_feasibility(self, planes_np, rows) -> None:
+        """Escape-time pruning prefetch (MYTHRIL_TPU_CHECK_ESCAPES=1 +
+        `--solver jax`): queue the feasibility queries of a whole slab of
+        deferred rows on the solver's batch dispatch queue before
+        _materialize_np walks them one at a time — the first row's
+        _feasible() then flushes the slab as ONE device batch instead of
+        paying a launch per lane. Best-effort: any trouble here just means
+        the rows solve individually, exactly as before."""
+        if not self.check_escapes:
+            return
+        from ..core.state.constraints import Constraints
+        from ..support.model import prefetch_models
+
+        sets = []
+        for row in rows:
+            if int(planes_np["cond_count"][row]) <= 0:
+                continue
+            ctx = self.contexts[int(planes_np["ctx_id"][row])]
+            constraints = Constraints(
+                list(ctx.template.world_state.constraints)
+                + self._cond_bools(planes_np, self.harena, row))
+            sets.append(tuple(constraints.get_all_constraints()))
+        if not sets:
+            return
+        try:
+            prefetch_models(sets)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as error:
+            log.debug("feasibility prefetch failed (%r) — rows solve "
+                      "individually", error)
 
     def _feasible(self, planes_np, harena, lane: int) -> bool:
         from ..core.state.constraints import Constraints

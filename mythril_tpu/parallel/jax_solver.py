@@ -380,3 +380,119 @@ def solve_cnf_device(clauses: List[List[int]], n_vars: int,
     if (status == S_UNSAT).all():
         return UNSAT, None
     return UNKNOWN, None
+
+
+@lru_cache(maxsize=32)
+def _get_batch_runner(chunk: int, forced_depth: int):
+    """Query-vmapped runner: one compiled executable per (chunk,
+    forced_depth); the query axis, like the problem tensors, is an argument
+    shape, so every batch in the same (n_tiles, v1, padded_batch) bucket
+    reuses it. Decided queries freeze in place (per-query active mask) so
+    one long solve does not burn steps re-deciding its finished siblings."""
+    import jax
+    import jax.numpy as jnp
+
+    def run_one(state, lits, valid, order):
+        def body(_, st):
+            decided = jnp.any(st.status == S_SAT) \
+                | jnp.all(st.status != SEARCHING)
+            advanced = _step(st, lits, valid, order, forced_depth)
+            return jax.tree_util.tree_map(
+                lambda new, old: jnp.where(decided, old, new), advanced, st)
+
+        return jax.lax.fori_loop(0, chunk, body, state)
+
+    return jax.jit(jax.vmap(run_one))
+
+
+def solve_cnf_device_batch(queries: List[Tuple[List[List[int]], int]],
+                           n_probes: int = 32, max_steps: int = 20_000,
+                           chunk: int = 256,
+                           clause_cap: Optional[int] = None
+                           ) -> List[Tuple[int, Optional[List[bool]]]]:
+    """Solve many independent CNFs in shape-bucketed device batches.
+
+    `queries` is a list of (clauses, n_vars); returns one (status, model)
+    per query, aligned, with the same per-query contract as
+    solve_cnf_device: trivial cases (empty CNF, empty clause) answer on the
+    host, oversize queries return UNKNOWN (caller falls back to CDCL), and
+    no query ever raises past the caller's classification layer.
+
+    Problems bucket by their padded (n_tiles, v1) shape — already pow2 from
+    _build_problem — and the query axis pads to pow2 by repeating the last
+    problem, so the vmapped runner's compile cache stays as small as the
+    single-query one's. The host loop early-exits a bucket once every REAL
+    query in it has a verdict (pad lanes never gate progress).
+
+    `clause_cap=None` reads DEFAULT_CLAUSE_CAP at call time, so the
+    dispatch layer (and tests) can tune the module global."""
+    import jax.numpy as jnp
+
+    if clause_cap is None:
+        clause_cap = DEFAULT_CLAUSE_CAP
+    results: List[Optional[Tuple[int, Optional[List[bool]]]]] = \
+        [None] * len(queries)
+    buckets: dict = {}  # (n_tiles, v1) -> [(query index, _Problem)]
+    for index, (clauses, n_vars) in enumerate(queries):
+        if not clauses:
+            results[index] = (SAT, [False] * n_vars)
+            continue
+        if any(not clause for clause in clauses):
+            results[index] = (UNSAT, None)
+            continue
+        if len(clauses) > clause_cap:
+            results[index] = (UNKNOWN, None)
+            continue
+        problem = _build_problem(clauses, n_vars)
+        key = (problem.lits.shape[0], problem.order.shape[0])
+        buckets.setdefault(key, []).append((index, problem))
+
+    forced_depth = max(0, int(np.log2(max(1, n_probes))))
+    for (n_tiles, v1), group in buckets.items():
+        n_real = len(group)
+        n_padded = _next_pow2(n_real)
+        problems = [problem for _, problem in group]
+        problems += [problems[-1]] * (n_padded - n_real)
+        try:
+            from ..smt.solver.solver_statistics import SolverStatistics
+
+            SolverStatistics().batch_bucket_shapes.add(
+                (n_tiles, v1, n_padded))
+        except ImportError:  # stats are observability, never a solve gate
+            pass
+
+        lits = jnp.asarray(np.stack([p.lits for p in problems]))
+        valid = jnp.asarray(np.stack([p.valid for p in problems]))
+        order = jnp.asarray(np.stack([p.order for p in problems]))
+        assign0 = np.stack([np.broadcast_to(p.init_assign, (n_probes, v1))
+                            for p in problems])
+        state = _SolverState(
+            assign=jnp.asarray(assign0),
+            trail=jnp.zeros((n_padded, n_probes, v1), dtype=jnp.int32),
+            tag=jnp.zeros((n_padded, n_probes, v1), dtype=jnp.int8),
+            trail_len=jnp.zeros((n_padded, n_probes), dtype=jnp.int32),
+            status=jnp.zeros((n_padded, n_probes), dtype=jnp.int8),
+        )
+        runner = _get_batch_runner(chunk, forced_depth)
+
+        steps = 0
+        while steps < max_steps:
+            state = runner(state, lits, valid, order)
+            steps += chunk
+            status = np.asarray(state.status)[:n_real]
+            if ((status == S_SAT).any(axis=1)
+                    | (status != SEARCHING).all(axis=1)).all():
+                break
+
+        status = np.asarray(state.status)
+        for slot, (index, problem) in enumerate(group):
+            sat_lanes = np.nonzero(status[slot] == S_SAT)[0]
+            if len(sat_lanes):
+                assign = np.asarray(state.assign[slot, int(sat_lanes[0])])
+                results[index] = (SAT, [bool(assign[v] == _TRUE)
+                                        for v in range(1, problem.n_vars + 1)])
+            elif (status[slot] == S_UNSAT).all():
+                results[index] = (UNSAT, None)
+            else:
+                results[index] = (UNKNOWN, None)
+    return results

@@ -1,0 +1,368 @@
+"""Batched device SAT dispatch: the single funnel between every solver
+caller and the device backend (ISSUE 3 tentpole).
+
+BENCH_r05 showed why this layer exists: the lockstep interpreter wins 12x on
+device, yet `--solver jax` lost 9x on the real contract corpus — because
+every feasibility/detection query paid a full device launch for ONE CNF.
+The GPU-SAT literature (ParaFROST, CUD@SAT) is unanimous that device solvers
+only pay off when many problems amortize one launch; this module is that
+amortization applied to the solver layer, exactly as PAPER.md applies it to
+the interpreter.
+
+The pieces, in query order:
+
+- **Canonical form** (`canonicalize`): sorted-literal normal form — literals
+  sorted and deduped within a clause, tautologies dropped, clauses deduped
+  and sorted, an empty clause collapsing the CNF to falsum. Variables are
+  NOT renumbered, so a model of the canonical CNF is a model of the
+  original, and syntactically shuffled repeats of one query share a key.
+- **Verdict cache**: bounded LRU over canonical CNFs holding SAT/UNSAT
+  verdicts (+ model). Sound independent of the caller's conflict budget:
+  the device answers UNKNOWN on exhaustion and UNKNOWN is never cached, so
+  a cached verdict is a real decision. Purged whenever the device backend
+  is quarantined — verdicts sourced from a device that has been caught
+  lying are not worth keeping.
+- **Deferred-flush queue**: `submit()` returns a lightweight future;
+  identical in-flight queries dedup onto one entry (conflict budgets merge
+  by max). The queue flushes when it reaches `MYTHRIL_TPU_BATCH_FLUSH`
+  entries, when a submit finds the oldest entry older than
+  `MYTHRIL_TPU_BATCH_AGE_MS`, or — the engine being single-threaded — the
+  moment any caller demands a result. Speculative prefetchers
+  (solver.prefetch_formulas / model.prefetch_models / the frontier's
+  escape-pruning slab) fill the queue so the first demanded result solves
+  the whole batch in one launch.
+- **Resilience contract** (support/resilience.py): one batch = one
+  `fire(DEVICE)` visit, one breaker `allow()` gate, failures classified
+  once per batch; the wall-overrun budget divides the batch's elapsed time
+  by its occupancy before comparing (a healthy, well-amortized batch must
+  not trip the breaker: N queries in one launch taking N x the per-query
+  budget is the whole point). `--device-crosscheck` keeps sampling
+  INDIVIDUAL queries out of a batch against the host oracle; a mid-batch
+  divergence quarantines the backend, hands the remaining entries to the
+  CDCL ladder, and purges the cache.
+
+`--no-batch-solve` bypasses queue and cache entirely (one query, one
+launch — the legacy `_device_solve` path, kept bit-identical for A/B).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+from . import sat
+from .solver_statistics import SolverStatistics
+
+Verdict = Tuple[int, Optional[List[bool]]]
+CanonicalKey = Tuple[int, Tuple[Tuple[int, ...], ...]]
+
+
+def flush_threshold() -> int:
+    """Queue length that forces a flush (MYTHRIL_TPU_BATCH_FLUSH)."""
+    return max(1, int(os.environ.get("MYTHRIL_TPU_BATCH_FLUSH", "16")))
+
+
+def flush_age_ms() -> float:
+    """Oldest-entry age that forces a flush at the next submit
+    (MYTHRIL_TPU_BATCH_AGE_MS)."""
+    return float(os.environ.get("MYTHRIL_TPU_BATCH_AGE_MS", "50"))
+
+
+def cache_size() -> int:
+    """Verdict-cache bound (MYTHRIL_TPU_VERDICT_CACHE)."""
+    return max(1, int(os.environ.get("MYTHRIL_TPU_VERDICT_CACHE", "4096")))
+
+
+def canonicalize(clauses: List[List[int]], n_vars: int) -> CanonicalKey:
+    """Sorted-literal normal form. Preserves equivalence AND variable
+    numbering (models transfer verbatim); collapses an empty clause to the
+    single-falsum CNF so every trivially-UNSAT query shares one key."""
+    seen = set()
+    canonical = []
+    for clause in clauses:
+        lit_set = set(clause)
+        if not lit_set:
+            return n_vars, ((),)
+        if any(-lit in lit_set for lit in lit_set):
+            continue  # tautology: satisfied by every assignment
+        lits = tuple(sorted(lit_set))
+        if lits in seen:
+            continue
+        seen.add(lits)
+        canonical.append(lits)
+    canonical.sort()
+    return n_vars, tuple(canonical)
+
+
+class _Entry:
+    """One unique in-flight query (deduped submissions share it)."""
+
+    __slots__ = ("key", "clauses", "n_vars", "max_conflicts", "created",
+                 "result")
+
+    def __init__(self, key: Optional[CanonicalKey], clauses: List[List[int]],
+                 n_vars: int, max_conflicts: int):
+        self.key = key
+        self.clauses = clauses
+        self.n_vars = n_vars
+        self.max_conflicts = max_conflicts
+        self.created = time.time()
+        self.result: Optional[Verdict] = None
+
+
+class QueryFuture:
+    """Lightweight handle on a submitted query. `result()` blocks by
+    flushing the queue (single-threaded engine: "blocking" is one device
+    batch away)."""
+
+    __slots__ = ("_queue", "_entry", "_result")
+
+    def __init__(self, queue: Optional["DispatchQueue"] = None,
+                 entry: Optional[_Entry] = None,
+                 result: Optional[Verdict] = None):
+        self._queue = queue
+        self._entry = entry
+        self._result = result
+
+    def done(self) -> bool:
+        return self._result is not None or (
+            self._entry is not None and self._entry.result is not None)
+
+    def result(self) -> Verdict:
+        if self._result is not None:
+            return self._result
+        if self._entry.result is None:
+            self._queue.flush()
+        if self._entry.result is None:
+            # a reset() raced the flush away; fail closed like any other
+            # device trouble — the caller's CDCL ladder decides
+            self._entry.result = (sat.UNKNOWN, None)
+        return self._entry.result
+
+
+class DispatchQueue:
+    """Process-wide query queue + verdict cache (single-threaded, like the
+    engine; solver.reset_solver_backend resets it per analysis)."""
+
+    def __init__(self):
+        self.pending: "OrderedDict[CanonicalKey, _Entry]" = OrderedDict()
+        self.cache: "OrderedDict[CanonicalKey, Tuple[int, Optional[Tuple[bool, ...]]]]" \
+            = OrderedDict()
+
+    # -- cache -----------------------------------------------------------------------
+
+    def _cache_get(self, key: CanonicalKey):
+        hit = self.cache.get(key)
+        if hit is not None:
+            self.cache.move_to_end(key)
+        return hit
+
+    def _cache_put(self, key: CanonicalKey, status: int,
+                   model: Optional[List[bool]]) -> None:
+        if status not in (sat.SAT, sat.UNSAT):
+            return  # UNKNOWN is budget-dependent, never a cacheable verdict
+        self.cache[key] = (status, tuple(model) if model is not None else None)
+        self.cache.move_to_end(key)
+        bound = cache_size()
+        while len(self.cache) > bound:
+            self.cache.popitem(last=False)
+
+    # -- queue -----------------------------------------------------------------------
+
+    def submit(self, clauses: List[List[int]], n_vars: int,
+               max_conflicts: int) -> QueryFuture:
+        """Queue one query; returns a future. Cache hits and in-flight
+        duplicates never reach the device."""
+        statistics = SolverStatistics()
+        statistics.batch_submitted += 1
+        key = canonicalize(clauses, n_vars)
+        cached = self._cache_get(key)
+        if cached is not None:
+            statistics.batch_cache_hits += 1
+            status, model = cached
+            return QueryFuture(
+                result=(status, list(model) if model is not None else None))
+        entry = self.pending.get(key)
+        if entry is not None:
+            statistics.batch_dedup_hits += 1
+            entry.max_conflicts = max(entry.max_conflicts, max_conflicts)
+            return QueryFuture(queue=self, entry=entry)
+        entry = _Entry(key, [list(lits) for lits in key[1]], n_vars,
+                       max_conflicts)
+        self.pending[key] = entry
+        future = QueryFuture(queue=self, entry=entry)
+        oldest = next(iter(self.pending.values()))
+        if len(self.pending) >= flush_threshold() or \
+                (time.time() - oldest.created) * 1000.0 >= flush_age_ms():
+            self.flush()
+        return future
+
+    def solve(self, clauses: List[List[int]], n_vars: int,
+              max_conflicts: int) -> Verdict:
+        """Synchronous solve. With batching on, this drains whatever the
+        prefetchers queued alongside; with `--no-batch-solve`, it is the
+        legacy one-query-one-launch path, bit for bit."""
+        if not enabled():
+            entry = _Entry(None, clauses, n_vars, max_conflicts)
+            self._execute_batch([entry], batched=False)
+            return entry.result
+        return self.submit(clauses, n_vars, max_conflicts).result()
+
+    def flush(self) -> None:
+        """Ship every pending entry to the device as one batch."""
+        if not self.pending:
+            return
+        entries = list(self.pending.values())
+        self.pending.clear()
+        self._execute_batch(entries, batched=True)
+
+    def reset(self) -> None:
+        """Fresh analysis: drop the queue (dangling futures fail closed as
+        UNKNOWN) and the verdict cache (cached models reference a discarded
+        pipeline's variable numbering)."""
+        for entry in self.pending.values():
+            entry.result = (sat.UNKNOWN, None)
+        self.pending.clear()
+        self.cache.clear()
+
+    # -- the device boundary ---------------------------------------------------------
+
+    def _execute_batch(self, entries: List[_Entry], batched: bool) -> None:
+        """One device launch for `entries`, under the full resilience
+        contract (one fire(DEVICE), one breaker gate, failures classified
+        per batch, wall budget divided by occupancy, crosscheck sampling
+        individual queries)."""
+        from ...parallel import jax_solver
+        from ...support import resilience
+        from .solver import _crosscheck_device_verdict
+
+        statistics = SolverStatistics()
+        health = resilience.registry.backend(resilience.DEVICE)
+        if not health.allow():
+            if health.state == resilience.QUARANTINED:
+                # quarantine can land between batches (divergence in another
+                # code path): stale verdicts must not outlive it
+                self.cache.clear()
+            statistics.device_skipped += len(entries)
+            for entry in entries:
+                entry.result = (sat.UNKNOWN, None)
+            return
+
+        statistics.device_queries += len(entries)
+        if batched:
+            statistics.batch_flushes += 1
+            statistics.batch_flushed_queries += len(entries)
+        max_steps = min(max(entry.max_conflicts for entry in entries), 50_000)
+        started = time.time()
+        try:
+            resilience.fire(resilience.DEVICE)
+            if len(entries) == 1:
+                entry = entries[0]
+                results = [jax_solver.solve_cnf_device(
+                    entry.clauses, entry.n_vars, max_steps=max_steps)]
+            else:
+                results = jax_solver.solve_cnf_device_batch(
+                    [(entry.clauses, entry.n_vars) for entry in entries],
+                    max_steps=max_steps,
+                    clause_cap=jax_solver.DEFAULT_CLAUSE_CAP)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as error:  # classified: OOM / compile / crash
+            failure_class = resilience.classify_failure(error)
+            log.warning(
+                "device batch failed [%s] (%r) on %d queries — falling "
+                "back to native CDCL", failure_class, error, len(entries))
+            health.record_failure(failure_class, repr(error))
+            statistics.device_fallbacks += len(entries)
+            for entry in entries:
+                entry.result = (sat.UNKNOWN, None)
+            return
+
+        elapsed = time.time() - started
+        if batched:
+            statistics.batch_device_time += elapsed
+        # wall budget per AMORTIZED query, not per batch: N queries sharing
+        # one launch legitimately take up to N x the per-query budget
+        # (ISSUE 3 satellite: the old code charged the whole batch's elapsed
+        # time as one query's overrun and tripped the breaker on healthy,
+        # well-amortized batches)
+        overran = False
+        budget_ms = resilience.device_wall_budget_ms()
+        if budget_ms:
+            elapsed_ms = elapsed * 1000.0
+            per_query_ms = elapsed_ms / len(entries)
+            if per_query_ms > budget_ms:
+                overran = True
+                log.warning(
+                    "device batch answered but took %.0f ms for %d queries "
+                    "(%.0f ms/query, budget %d ms) — recording wall_overrun",
+                    elapsed_ms, len(entries), per_query_ms, budget_ms)
+                health.record_failure(
+                    resilience.WALL_OVERRUN,
+                    f"{elapsed_ms:.0f}ms/{len(entries)} queries")
+
+        decided_any = False
+        for position, (entry, (status, model)) in enumerate(
+                zip(entries, results)):
+            if health.state == resilience.QUARANTINED:
+                # an earlier entry in this batch diverged: the device's
+                # remaining answers are untrusted — hand them to the ladder
+                statistics.device_fallbacks += 1
+                entry.result = (sat.UNKNOWN, None)
+                continue
+            if status == sat.UNKNOWN:
+                statistics.device_fallbacks += 1
+                entry.result = (sat.UNKNOWN, None)
+                continue
+            status, model = _crosscheck_device_verdict(
+                entry.clauses, entry.n_vars, entry.max_conflicts, status,
+                model)
+            statistics.device_solved += 1
+            if status != sat.UNKNOWN:
+                decided_any = True
+            if batched and entry.key is not None \
+                    and health.state != resilience.QUARANTINED:
+                self._cache_put(entry.key, status, model)
+            entry.result = (status, model)
+        if health.state == resilience.QUARANTINED:
+            self.cache.clear()
+        elif not overran and decided_any:
+            health.record_success()
+
+
+#: process-wide queue (solver.reset_solver_backend calls reset())
+_QUEUE = DispatchQueue()
+
+
+def enabled() -> bool:
+    """Batching on? (`--no-batch-solve` turns it off for A/B runs.)"""
+    from ...support.support_args import args
+
+    return bool(getattr(args, "batch_solve", True))
+
+
+def submit(clauses: List[List[int]], n_vars: int,
+           max_conflicts: int) -> QueryFuture:
+    return _QUEUE.submit(clauses, n_vars, max_conflicts)
+
+
+def solve(clauses: List[List[int]], n_vars: int,
+          max_conflicts: int) -> Verdict:
+    return _QUEUE.solve(clauses, n_vars, max_conflicts)
+
+
+def flush() -> None:
+    _QUEUE.flush()
+
+
+def pending_count() -> int:
+    return len(_QUEUE.pending)
+
+
+def reset() -> None:
+    _QUEUE.reset()

@@ -238,13 +238,14 @@ class IncrementalPipeline:
 
     # -- the decision procedure ------------------------------------------------------
 
-    def check(self, raw_constraints: List[terms.Term], max_conflicts: int,
-              device_solve=None, timeout_ms: int = 0
-              ) -> Tuple[str, Optional[Model]]:
-        """Same contract as solver.check_formulas. `device_solve` is an
-        optional callable(clauses, n_vars, max_conflicts) -> (status, bits)
-        used as a pre-pass (the --solver jax lane). timeout_ms > 0 is a hard
-        wall-clock deadline enforced inside the native solve loop."""
+    def _prepare(self, raw_constraints: List[terms.Term]):
+        """Lower the constraints against the global registries and blast
+        them into the monotone pool (all idempotent on repeat: the lower
+        cache, structural hashing and the Ackermann emitted-set make a
+        second pass over the same set free). Returns
+        (lowered, fresh_vars, assumptions). Does NOT ship clauses to the
+        native session — the cursor advances only in check(), so a
+        speculative prepare leaves session state untouched."""
         reads_before = len(self.info.array_reads)
         ufs_before = len(self.info.uf_applications)
         lowered = [_lower(c, self.lower_cache, self.info)
@@ -258,6 +259,35 @@ class IncrementalPipeline:
             self._fact_lits.append((pair, self.blaster.assert_true(fact)))
 
         assumptions = [self.blaster.blast_bool(node) for node in lowered]
+        return lowered, fresh_vars, assumptions
+
+    def prepare_device_query(self, raw_constraints: List[terms.Term]
+                             ) -> Optional[Tuple[List[List[int]], int]]:
+        """Build the device cone for a query WITHOUT solving it — the
+        prefetch half of the batch dispatch layer (solver.prefetch_formulas).
+
+        Cone extraction is a deterministic traversal and the sub-CNF is
+        deterministically renumbered, so a later real check() over the same
+        set produces the identical CNF — its dispatch submission dedups
+        onto the prefetched entry (or hits the verdict cache). The pool
+        mutations here are exactly the monotone ones check() would make;
+        session clause shipping stays with check(). Returns
+        (clauses, n_vars) or None when the cone exceeds the device cap."""
+        _, fresh_vars, assumptions = self._prepare(raw_constraints)
+        sub = self._device_subproblem(assumptions, fresh_vars)
+        if sub is None:
+            return None
+        sub_clauses, n_sub_vars, _renumber = sub
+        return sub_clauses, n_sub_vars
+
+    def check(self, raw_constraints: List[terms.Term], max_conflicts: int,
+              device_solve=None, timeout_ms: int = 0
+              ) -> Tuple[str, Optional[Model]]:
+        """Same contract as solver.check_formulas. `device_solve` is an
+        optional callable(clauses, n_vars, max_conflicts) -> (status, bits)
+        used as a pre-pass (the --solver jax lane). timeout_ms > 0 is a hard
+        wall-clock deadline enforced inside the native solve loop."""
+        lowered, fresh_vars, assumptions = self._prepare(raw_constraints)
 
         new_clauses = self.blaster.clauses[self._shipped:]
         self._shipped = len(self.blaster.clauses)

@@ -95,61 +95,81 @@ def _crosscheck_device_verdict(clauses, n_vars, max_conflicts, status, model):
 
 
 def _device_solve(clauses, n_vars, max_conflicts):
-    """The `--solver jax` lane (parallel/jax_solver.py): batched device DPLL
-    with UNKNOWN on failure or oversize, so the caller falls back to the
-    native CDCL. A device failure must never surface as "no issues": it is
-    classified (support/resilience.py), logged, and counted per failure
-    domain; `trip_after` consecutive failures trip the backend's circuit
-    breaker so a sick device stops paying XLA recompiles per query."""
-    from ...parallel import jax_solver
+    """The `--solver jax` lane: every device query routes through the batch
+    dispatch layer (dispatch.py) — canonical-CNF verdict cache, in-flight
+    dedup, deferred-flush batching onto `jax_solver.solve_cnf_device_batch`
+    — under the resilience contract (one fire(DEVICE)/breaker gate per
+    batch, failures classified, wall budget amortized by occupancy,
+    crosscheck sampling individual queries). UNKNOWN on failure or
+    oversize, so the caller falls back to the native CDCL; with
+    `--no-batch-solve` this is the legacy one-query-one-launch path."""
+    from . import dispatch
+
+    return dispatch.solve(clauses, n_vars, max_conflicts)
+
+
+def prefetch_formulas(constraint_sets, max_conflicts: int = 2_000_000) -> int:
+    """Speculatively queue the device cones of several independent
+    constraint sets on the batch dispatch queue WITHOUT flushing: the next
+    check_formulas over any of them lands on the queue's in-flight dedup
+    (or the verdict cache once a flush ran) and shares one device launch
+    with its siblings. Best-effort and side-effect-free for correctness:
+    lowering failures skip the set, the pool mutations are the same
+    monotone ones the real check would make, and nothing here decides a
+    query. Returns the number of sets actually queued."""
+    from ...support.support_args import args
+    from . import dispatch
+
+    if args.solver != "jax" or not dispatch.enabled():
+        return 0
     from ...support import resilience
 
-    statistics = SolverStatistics()
-    health = resilience.registry.backend(resilience.DEVICE)
-    if not health.allow():
-        statistics.device_skipped += 1
-        return jax_solver.UNKNOWN, None
-    statistics.device_queries += 1
-    started = time.time()
-    try:
-        resilience.fire(resilience.DEVICE)
-        status, model = jax_solver.solve_cnf_device(
-            clauses, n_vars, max_steps=min(max_conflicts, 50_000))
-    except (KeyboardInterrupt, SystemExit):
-        raise
-    except Exception as error:  # classified below: OOM / compile / crash
-        failure_class = resilience.classify_failure(error)
-        log.warning(
-            "device solver failed [%s] (%r) on %d clauses / %d vars — "
-            "falling back to native CDCL", failure_class, error,
-            len(clauses), n_vars)
-        health.record_failure(failure_class, repr(error))
-        statistics.device_fallbacks += 1
-        return jax_solver.UNKNOWN, None
+    # peek, never allow(): an OPEN breaker's skip counter belongs to real
+    # queries, and speculative work against a sick device is pure waste
+    if resilience.registry.backend(resilience.DEVICE).state != \
+            resilience.CLOSED:
+        return 0
+    pipeline = _get_pipeline()
+    if pipeline is None:
+        return 0
+    submitted = 0
+    for raw_constraints in constraint_sets:
+        pending = []
+        constant_false = False
+        for constraint in raw_constraints:
+            if constraint is terms.TRUE:
+                continue
+            if constraint is terms.FALSE:
+                constant_false = True
+                break
+            pending.append(constraint)
+        if constant_false or not pending:
+            continue
+        if getattr(args, "simplify", True):
+            from .simplify import simplify_constraints
 
-    # a sick backend often still answers — after minutes of recompile; a
-    # wall-clock overrun counts against its health even when the verdict is
-    # usable (the breaker exists to stop paying that latency per query)
-    overran = False
-    budget_ms = resilience.device_wall_budget_ms()
-    if budget_ms:
-        elapsed_ms = (time.time() - started) * 1000.0
-        if elapsed_ms > budget_ms:
-            overran = True
-            log.warning("device solve answered but took %.0f ms "
-                        "(budget %d ms) — recording wall_overrun",
-                        elapsed_ms, budget_ms)
-            health.record_failure(resilience.WALL_OVERRUN,
-                                  f"{elapsed_ms:.0f}ms")
-    if status == jax_solver.UNKNOWN:
-        statistics.device_fallbacks += 1
-        return status, None
-    status, model = _crosscheck_device_verdict(clauses, n_vars,
-                                               max_conflicts, status, model)
-    if not overran:
-        health.record_success()
-    statistics.device_solved += 1
-    return status, model
+            outcome = simplify_constraints(pending)
+            if outcome.is_false:
+                continue
+            pending = outcome.constraints
+            if not pending:
+                continue
+        try:
+            cone = pipeline.prepare_device_query(pending)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as error:
+            # speculation must never surface a failure the real query
+            # wouldn't hit identically — skip the set, the real check pays
+            log.debug("device prefetch lowering failed (%r) — set skipped",
+                      error)
+            continue
+        if cone is None:
+            continue
+        sub_clauses, n_sub_vars = cone
+        dispatch.submit(sub_clauses, n_sub_vars, max_conflicts)
+        submitted += 1
+    return submitted
 
 
 def _solve_backend(clauses, n_vars, max_conflicts, timeout_ms=0):
@@ -191,6 +211,11 @@ def reset_solver_backend() -> None:
     if _pipeline is not None:
         _pipeline.close()
         _pipeline = None
+    # in-flight batch entries and cached verdicts reference the discarded
+    # pipeline's variable numbering — drop them with it
+    from . import dispatch
+
+    dispatch.reset()
     from ...support import model as model_service
 
     model_service.reset_model_caches()
@@ -387,6 +412,24 @@ class Optimize(BaseSolver):
             left = int((deadline - time.time()) * 1000)
             budget = self._budget()
             return min(budget, max(left, 1)) if budget else max(left, 1)
+
+        # speculative extreme-probe prefetch (`--solver jax` + batching):
+        # witness minimization usually drives every objective straight to
+        # its extreme (value and calldatasize minimize to 0), so queue the
+        # whole extreme-probe ladder on the dispatch queue now — the first
+        # probe's check flushes them as ONE device batch, and the later
+        # probes hit the verdict cache instead of launching again
+        speculative = []
+        spec_bounds: List[terms.Term] = []
+        for objective, is_minimize in self._objectives:
+            obj_raw = objective.raw
+            width = obj_raw.width
+            extreme_value = 0 if is_minimize else (1 << width) - 1
+            pin = terms.bv_cmp("eq", obj_raw,
+                               terms.bv_const(extreme_value, width))
+            speculative.append(raw + spec_bounds + [pin])
+            spec_bounds.append(pin)
+        prefetch_formulas(speculative, self._budget())
 
         bound_terms: List[terms.Term] = []
         for objective, is_minimize in self._objectives:

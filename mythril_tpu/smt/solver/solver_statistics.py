@@ -23,6 +23,7 @@ class SolverStatistics:
             cls._instance.device_solved = 0
             cls._instance._init_simplify()
             cls._instance._init_resilience()
+            cls._instance._init_batch()
         return cls._instance
 
     def _init_simplify(self) -> None:
@@ -54,6 +55,43 @@ class SolverStatistics:
         self.divergences = 0
         self.backends_quarantined = []
 
+    def _init_batch(self) -> None:
+        # batched device dispatch (smt/solver/dispatch.py)
+        #: total submissions, including ones answered by cache/dedup
+        self.batch_submitted = 0
+        #: submissions answered from the canonical-CNF verdict cache
+        self.batch_cache_hits = 0
+        #: submissions merged into an identical in-flight queue entry
+        self.batch_dedup_hits = 0
+        #: device flushes and the unique queries they carried
+        self.batch_flushes = 0
+        self.batch_flushed_queries = 0
+        #: wall seconds inside device batch calls (amortized latency numerator)
+        self.batch_device_time = 0.0
+        #: distinct (n_tiles, v1, padded_batch) shapes the batch runner
+        #: compiled — the XLA compile-cache pressure the pow2 bucketing bounds
+        self.batch_bucket_shapes = set()
+
+    def batch_metrics(self) -> dict:
+        """Derived batch-dispatch metrics for reports/bench JSON."""
+        flushes = self.batch_flushes
+        flushed = self.batch_flushed_queries
+        submitted = self.batch_submitted
+        return {
+            "submitted": submitted,
+            "cache_hits": self.batch_cache_hits,
+            "dedup_hits": self.batch_dedup_hits,
+            "flushes": flushes,
+            "flushed_queries": flushed,
+            "occupancy": round(flushed / flushes, 2) if flushes else 0.0,
+            "cache_hit_rate": round(self.batch_cache_hits / submitted, 3)
+            if submitted else 0.0,
+            "buckets_compiled": len(self.batch_bucket_shapes),
+            "amortized_ms_per_query": round(
+                self.batch_device_time * 1000.0 / flushed, 2)
+            if flushed else 0.0,
+        }
+
     def reset(self) -> None:
         self.query_count = 0
         self.solver_time = 0.0
@@ -62,6 +100,7 @@ class SolverStatistics:
         self.device_solved = 0
         self._init_simplify()
         self._init_resilience()
+        self._init_batch()
 
     def __repr__(self):
         out = (f"Solver statistics: query count: {self.query_count}, "
@@ -80,6 +119,16 @@ class SolverStatistics:
                     f"{self.simplify_selects_bounded} bounded-selects, "
                     f"{self.simplify_extract_fusions} extract/concat, "
                     f"~{self.simplify_clauses_avoided} clauses avoided)")
+        if self.batch_submitted:
+            metrics = self.batch_metrics()
+            out += (f", batch dispatch: {metrics['submitted']} submitted "
+                    f"(cache hit rate: {metrics['cache_hit_rate']:.1%}, "
+                    f"dedup hits: {metrics['dedup_hits']}, "
+                    f"occupancy: {metrics['occupancy']}/flush over "
+                    f"{metrics['flushes']} flushes, "
+                    f"buckets compiled: {metrics['buckets_compiled']}, "
+                    f"amortized: {metrics['amortized_ms_per_query']} "
+                    f"ms/query)")
         if self.failure_counts or self.breaker_trips or self.device_skipped:
             classified = ", ".join(f"{key}={count}" for key, count
                                    in sorted(self.failure_counts.items()))

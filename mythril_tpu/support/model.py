@@ -85,6 +85,58 @@ def reset_model_caches() -> None:
 _ZERO_MODEL = Model()
 
 
+def prefetch_models(constraint_tuples: Iterable[Tuple]) -> int:
+    """Speculatively queue device SAT work for several upcoming get_model
+    calls (`--solver jax` + batching only; a cheap no-op otherwise).
+
+    Mirrors get_model's fast paths — constant-false, simplification,
+    result-cache, zero-model and quick-sat probes — so only the sets that
+    WOULD reach the solver get queued, then hands them to
+    solver.prefetch_formulas. The later real get_model over the same set
+    dedups onto the in-flight batch entry or hits the dispatch verdict
+    cache: N feasibility checks, one device launch. Returns the number of
+    sets queued."""
+    if args.solver != "jax" or not getattr(args, "batch_solve", True):
+        return 0
+    from ..smt.solver import solver as solver_service
+
+    sets = []
+    for constraints in constraint_tuples:
+        raw_constraints = []
+        constant_false = False
+        for constraint in constraints:
+            raw = constraint.raw if isinstance(constraint, Bool) else constraint
+            if raw is terms.FALSE:
+                constant_false = True
+                break
+            if raw is not terms.TRUE:
+                raw_constraints.append(raw)
+        if constant_false:
+            continue
+        if getattr(args, "simplify", True):
+            from ..smt.solver.simplify import simplify_constraints
+
+            outcome = simplify_constraints(raw_constraints)
+            if outcome.is_false:
+                continue
+            raw_constraints = outcome.constraints
+        if not raw_constraints:
+            continue
+        if _result_cache.get(tuple(raw_constraints)) is not None:
+            continue
+        try:
+            if all(_ZERO_MODEL.eval(c) for c in raw_constraints):
+                continue
+        except (KeyError, ValueError, TypeError):
+            pass  # zero probe failed to evaluate: the set stays a candidate
+        if model_cache.check_quick_sat(raw_constraints) is not None:
+            continue
+        sets.append(raw_constraints)
+    if not sets:
+        return 0
+    return solver_service.prefetch_formulas(sets)
+
+
 def get_model(constraints, minimize: Tuple = (), maximize: Tuple = (),
               enforce_execution_time: bool = True,
               solver_timeout: Optional[int] = None) -> Model:

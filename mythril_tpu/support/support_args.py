@@ -40,6 +40,10 @@ class Args:
         #: word-level simplification ahead of the bit-blaster (smt/solver/simplify.py);
         #: --no-simplify turns it off for A/B measurement
         self.simplify = True
+        #: batched device SAT dispatch (smt/solver/dispatch.py): verdict
+        #: cache + deferred-flush query batching on the jax lane;
+        #: --no-batch-solve turns it off for A/B measurement
+        self.batch_solve = True
         self.sparse_pruning = True
         self.enable_state_merging = False
         self.enable_summaries = False

@@ -1,0 +1,404 @@
+"""Batched device SAT dispatch (ISSUE 3 tentpole): canonicalization, verdict
+cache, deferred-flush queue, bucket-padding edges, occupancy-divided wall
+budget, and batched-vs-sequential verdict parity.
+
+Tier-1 never runs a real XLA solve (the jax DPLL pays seconds of compile per
+clause shape): the device entry points are monkeypatched at the jax_solver
+module attributes — exactly where dispatch._execute_batch resolves them — to
+the pure-Python DPLL. The one real-device batch parity test is marked slow.
+Note solve_cnf_device's `clause_cap` default binds at def time, so oversize
+tests patch the module global `DEFAULT_CLAUSE_CAP`, which the batch path
+reads at call time."""
+
+import os
+import random
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from mythril_tpu.parallel import jax_solver
+from mythril_tpu.smt.solver import dispatch, sat
+from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+from mythril_tpu.support import resilience
+from mythril_tpu.support.support_args import args
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    resilience.reset()
+    SolverStatistics().reset()
+    dispatch.reset()
+    monkeypatch.setattr(args, "device_crosscheck", 0)
+    monkeypatch.setattr(args, "batch_solve", True)
+    # queue only flushes on explicit demand unless a test opts in
+    monkeypatch.setenv("MYTHRIL_TPU_BATCH_FLUSH", "64")
+    monkeypatch.setenv("MYTHRIL_TPU_BATCH_AGE_MS", "60000")
+    yield
+    resilience.reset()
+    SolverStatistics().reset()
+    dispatch.reset()
+
+
+class FakeDevice:
+    """Python-DPLL stand-in for both device entry points, with call ledger."""
+
+    def __init__(self):
+        self.single_calls = []
+        self.batch_calls = []
+
+    def install(self, monkeypatch):
+        def single(clauses, n_vars, **kwargs):
+            self.single_calls.append((clauses, n_vars))
+            return sat.solve_cnf_python(clauses, n_vars)
+
+        def batch(queries, **kwargs):
+            self.batch_calls.append(list(queries))
+            return [sat.solve_cnf_python(clauses, n_vars)
+                    for clauses, n_vars in queries]
+
+        monkeypatch.setattr(jax_solver, "solve_cnf_device", single)
+        monkeypatch.setattr(jax_solver, "solve_cnf_device_batch", batch)
+        return self
+
+    @property
+    def queries_seen(self):
+        return len(self.single_calls) + sum(len(batch)
+                                            for batch in self.batch_calls)
+
+
+def _satisfies(clauses, model):
+    return all(any(model[abs(lit) - 1] == (lit > 0) for lit in clause)
+               for clause in clauses)
+
+
+def _random_cnf(rng, n_vars=4, n_clauses=8):
+    clauses = []
+    for _ in range(n_clauses):
+        cl_vars = rng.sample(range(1, n_vars + 1), 3)
+        clauses.append([v if rng.random() < 0.5 else -v for v in cl_vars])
+    return clauses, n_vars
+
+
+# -- canonicalization -----------------------------------------------------------------
+
+
+def test_canonicalize_permutation_invariant():
+    a = dispatch.canonicalize([[2, 1], [-3, 1], [1, 2]], 3)
+    b = dispatch.canonicalize([[1, -3], [1, 2, 2]], 3)
+    assert a == b
+    assert a[0] == 3
+
+
+def test_canonicalize_drops_tautologies():
+    assert dispatch.canonicalize([[1, -1], [2]], 2) == (2, ((2,),))
+    # a CNF of only tautologies canonicalizes to the empty (trivially SAT) CNF
+    assert dispatch.canonicalize([[1, -1]], 2) == (2, ())
+
+
+def test_canonicalize_empty_clause_collapses_to_falsum():
+    assert dispatch.canonicalize([[1, 2], []], 2) == (2, ((),))
+    assert dispatch.canonicalize([[]], 7) == (7, ((),))
+
+
+def test_canonicalize_preserves_variable_numbering():
+    """No renumbering: a model of the canonical CNF is a model of the
+    original, verbatim."""
+    clauses = [[4, -2], [2, 4]]
+    n_vars, canonical = dispatch.canonicalize(clauses, 4)
+    status, model = sat.solve_cnf_python([list(c) for c in canonical], n_vars)
+    assert status == sat.SAT
+    assert _satisfies(clauses, model)
+
+
+# -- queue: dedup, cache, flush triggers ----------------------------------------------
+
+
+def test_in_flight_dedup_single_device_query(monkeypatch):
+    device = FakeDevice().install(monkeypatch)
+    f1 = dispatch.submit([[1, 2], [-1]], 2, 1000)
+    f2 = dispatch.submit([[2, 1], [-1], [1, 2]], 2, 5000)  # same canonical CNF
+    assert dispatch.pending_count() == 1
+    assert SolverStatistics().batch_dedup_hits == 1
+    assert f1.result() == f2.result()
+    assert f1.result()[0] == sat.SAT
+    assert device.queries_seen == 1
+
+
+def test_dedup_merges_conflict_budgets_by_max(monkeypatch):
+    FakeDevice().install(monkeypatch)
+    dispatch.submit([[1]], 1, 100)
+    dispatch.submit([[1]], 1, 9000)
+    entry = next(iter(dispatch._QUEUE.pending.values()))
+    assert entry.max_conflicts == 9000
+
+
+def test_verdict_cache_hit_skips_device(monkeypatch):
+    device = FakeDevice().install(monkeypatch)
+    first = dispatch.solve([[1, 2], [-1]], 2, 1000)
+    assert first[0] == sat.SAT
+    assert device.queries_seen == 1
+    # shuffled repeat: canonical key matches, device never called again
+    again = dispatch.submit([[-1], [2, 1]], 2, 1000)
+    assert again.done()
+    status, model = again.result()
+    assert status == sat.SAT
+    assert _satisfies([[1, 2], [-1]], model)
+    assert device.queries_seen == 1
+    assert SolverStatistics().batch_cache_hits == 1
+
+
+def test_unknown_never_cached(monkeypatch):
+    def unknown_device(clauses, n_vars, **kwargs):
+        return sat.UNKNOWN, None
+
+    monkeypatch.setattr(jax_solver, "solve_cnf_device", unknown_device)
+    dispatch.solve([[1]], 1, 10)
+    assert dispatch._QUEUE.cache == {}
+    # a later, better-budgeted attempt must reach the device again
+    device = FakeDevice().install(monkeypatch)
+    assert dispatch.solve([[1]], 1, 10)[0] == sat.SAT
+    assert device.queries_seen == 1
+
+
+def test_cache_lru_eviction(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_VERDICT_CACHE", "2")
+    monkeypatch.setenv("MYTHRIL_TPU_BATCH_FLUSH", "1")  # flush every submit
+    device = FakeDevice().install(monkeypatch)
+    cnf_a, cnf_b, cnf_c = [[1]], [[2], [1]], [[3], [2], [1]]
+    for cnf in (cnf_a, cnf_b, cnf_c):
+        assert dispatch.solve(cnf, 3, 1000)[0] == sat.SAT
+    assert device.queries_seen == 3
+    assert len(dispatch._QUEUE.cache) == 2
+    # c is hot, a was evicted
+    dispatch.solve(cnf_c, 3, 1000)
+    assert device.queries_seen == 3
+    dispatch.solve(cnf_a, 3, 1000)
+    assert device.queries_seen == 4
+
+
+def test_flush_threshold_triggers_batch(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_BATCH_FLUSH", "2")
+    device = FakeDevice().install(monkeypatch)
+    f1 = dispatch.submit([[1]], 1, 1000)
+    assert dispatch.pending_count() == 1
+    f2 = dispatch.submit([[1, 2], [-2]], 2, 1000)
+    # threshold hit: both flushed in ONE device batch
+    assert dispatch.pending_count() == 0
+    assert f1.done() and f2.done()
+    assert len(device.batch_calls) == 1
+    assert len(device.batch_calls[0]) == 2
+    assert SolverStatistics().batch_flushes == 1
+    assert SolverStatistics().batch_flushed_queries == 2
+
+
+def test_age_threshold_triggers_flush(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_BATCH_AGE_MS", "0")
+    device = FakeDevice().install(monkeypatch)
+    future = dispatch.submit([[1]], 1, 1000)
+    assert future.done()
+    assert dispatch.pending_count() == 0
+    assert device.queries_seen == 1
+
+
+def test_reset_fails_dangling_futures_closed(monkeypatch):
+    FakeDevice().install(monkeypatch)
+    future = dispatch.submit([[1]], 1, 1000)
+    dispatch.reset()
+    assert future.result() == (sat.UNKNOWN, None)
+    assert dispatch._QUEUE.cache == {}
+
+
+# -- bucket-padding / size edges ------------------------------------------------------
+
+
+def test_batch_runner_trivial_and_oversize_edges():
+    """solve_cnf_device_batch host fast-paths: empty CNF, empty clause, and
+    oversize answer without touching the device (no XLA compile here)."""
+    big = [[1, 2], [-1, 2], [1, -2]]
+    results = jax_solver.solve_cnf_device_batch(
+        [([], 3), ([[]], 2), (big, 2)], clause_cap=2)
+    assert results[0] == (sat.SAT, [False, False, False])
+    assert results[1] == (sat.UNSAT, None)
+    assert results[2] == (sat.UNKNOWN, None)
+    assert jax_solver.solve_cnf_device_batch([]) == []
+
+
+def test_dispatch_trivial_cnfs_answer_on_host():
+    """Through the full dispatch path: the real device entry points answer
+    trivial CNFs host-side (solve_cnf_device's own fast-paths)."""
+    assert dispatch.solve([], 3, 1000) == (sat.SAT, [False, False, False])
+    assert dispatch.solve([[]], 2, 1000) == (sat.UNSAT, None)
+    # empty clause anywhere collapses the whole CNF to falsum
+    assert dispatch.solve([[1, 2], []], 2, 1000) == (sat.UNSAT, None)
+
+
+def test_oversize_batch_returns_unknown_via_module_cap(monkeypatch):
+    """dispatch's multi-entry path reads DEFAULT_CLAUSE_CAP at call time, so
+    patching the module global caps the real batch runner (def-time-bound
+    defaults would ignore this)."""
+    monkeypatch.setattr(jax_solver, "DEFAULT_CLAUSE_CAP", 2)
+    f1 = dispatch.submit([[1, 2], [-1, 2], [1, -2]], 2, 1000)
+    f2 = dispatch.submit([[3, 4], [-3, 4], [3, -4]], 4, 1000)
+    dispatch.flush()
+    assert f1.result() == (sat.UNKNOWN, None)
+    assert f2.result() == (sat.UNKNOWN, None)
+    assert SolverStatistics().device_fallbacks == 2
+    assert dispatch._QUEUE.cache == {}  # UNKNOWN never cached
+
+
+# -- resilience contract --------------------------------------------------------------
+
+
+def test_one_breaker_visit_per_batch(monkeypatch):
+    """N queries in one flush = ONE fire(DEVICE) visit: --inject-fault
+    CLASS:NTH counts batches, not queries."""
+    device = FakeDevice().install(monkeypatch)
+    resilience.configure("device_oom:1")
+    try:
+        futures = [dispatch.submit([[v]], v, 1000) for v in range(1, 4)]
+        dispatch.flush()
+        # the injected OOM fired once, on the whole batch
+        assert [f.result() for f in futures] == [(sat.UNKNOWN, None)] * 3
+        assert device.queries_seen == 0
+        health = resilience.registry.backend(resilience.DEVICE)
+        assert health.failure_counts == {resilience.DEVICE_OOM: 1}
+        assert SolverStatistics().device_fallbacks == 3
+        # next batch: the plan is spent, the breaker is still CLOSED
+        assert health.state == resilience.CLOSED
+        assert dispatch.solve([[1]], 1, 1000)[0] == sat.SAT
+        assert device.queries_seen == 1
+    finally:
+        resilience.configure(None)
+
+
+def test_wall_budget_divided_by_occupancy(monkeypatch):
+    """A well-amortized batch must NOT trip the wall budget: elapsed time is
+    divided by the batch's occupancy before comparing (ISSUE 3 satellite —
+    the old per-query accounting charged the whole batch to one query)."""
+    monkeypatch.setenv("MYTHRIL_TPU_DEVICE_WALL_MS", "40")
+
+    def slow_batch(queries, **kwargs):
+        time.sleep(0.08)  # 80ms / 8 queries = 10ms per query, budget 40
+        return [sat.solve_cnf_python(clauses, n_vars)
+                for clauses, n_vars in queries]
+
+    monkeypatch.setattr(jax_solver, "solve_cnf_device_batch", slow_batch)
+    futures = [dispatch.submit([[v]], v, 1000) for v in range(1, 9)]
+    dispatch.flush()
+    assert all(f.result()[0] == sat.SAT for f in futures)
+    health = resilience.registry.backend(resilience.DEVICE)
+    assert resilience.WALL_OVERRUN not in health.failure_counts
+
+
+def test_wall_budget_still_trips_on_slow_single_query(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_DEVICE_WALL_MS", "40")
+
+    def slow_single(clauses, n_vars, **kwargs):
+        time.sleep(0.08)  # 80ms / 1 query: genuinely over budget
+        return sat.solve_cnf_python(clauses, n_vars)
+
+    monkeypatch.setattr(jax_solver, "solve_cnf_device", slow_single)
+    assert dispatch.solve([[1]], 1, 1000)[0] == sat.SAT
+    health = resilience.registry.backend(resilience.DEVICE)
+    assert health.failure_counts.get(resilience.WALL_OVERRUN) == 1
+
+
+def test_quarantine_purges_verdict_cache(monkeypatch):
+    """Verdicts sourced from a quarantined device must not survive: the
+    cache is purged and later queries fall to the ladder (UNKNOWN here)."""
+    device = FakeDevice().install(monkeypatch)
+    cnf = [[1, 2], [-1]]
+    assert dispatch.solve(cnf, 2, 1000)[0] == sat.SAT
+    assert len(dispatch._QUEUE.cache) == 1
+    resilience.registry.backend(resilience.DEVICE).quarantine("test")
+    # a distinct query drains through the refused batch, purging the cache
+    assert dispatch.solve([[2]], 2, 1000) == (sat.UNKNOWN, None)
+    assert dispatch._QUEUE.cache == {}
+    # the previously cached verdict is gone with it
+    assert dispatch.solve(cnf, 2, 1000) == (sat.UNKNOWN, None)
+    assert device.queries_seen == 1  # only the pre-quarantine solve
+    assert SolverStatistics().device_skipped == 2
+
+
+# -- parity: batched vs sequential, --no-batch-solve A/B ------------------------------
+
+
+def test_batched_matches_sequential_verdicts(monkeypatch):
+    """Acceptance: bit-identical SAT/UNSAT statuses batched vs sequential
+    over a seeded random corpus (with repeats), and every SAT model
+    satisfies its clauses."""
+    rng = random.Random(1337)
+    corpus = [_random_cnf(rng) for _ in range(10)]
+    corpus += [corpus[2], corpus[5]]  # repeats exercise dedup + cache
+
+    # sequential ground truth straight from the DPLL floor
+    expected = [sat.solve_cnf_python(clauses, n_vars)[0]
+                for clauses, n_vars in corpus]
+    assert sat.SAT in expected  # the sweep must exercise model extraction
+
+    device = FakeDevice().install(monkeypatch)
+    futures = [dispatch.submit(clauses, n_vars, 100000)
+               for clauses, n_vars in corpus]
+    results = [f.result() for f in futures]
+
+    assert [status for status, _ in results] == expected
+    for (clauses, _), (status, model) in zip(corpus, results):
+        if status == sat.SAT:
+            assert _satisfies(clauses, model)
+    # the repeats were deduped/cached: the device saw only unique CNFs
+    assert device.queries_seen <= 10
+    statistics = SolverStatistics()
+    assert statistics.batch_submitted == 12
+    assert statistics.batch_cache_hits + statistics.batch_dedup_hits >= 2
+    metrics = statistics.batch_metrics()
+    assert metrics["flushed_queries"] == device.queries_seen
+    assert metrics["occupancy"] >= 1.0
+    assert metrics["cache_hit_rate"] >= 0.0
+
+
+def test_no_batch_solve_ab_parity(monkeypatch):
+    """--no-batch-solve: same verdicts, no queue/cache involvement — one
+    query, one launch, zero batch accounting (the legacy path, bit for
+    bit)."""
+    rng = random.Random(99)
+    corpus = [_random_cnf(rng) for _ in range(6)]
+
+    device = FakeDevice().install(monkeypatch)
+    batched = [dispatch.solve(clauses, n_vars, 100000)[0]
+               for clauses, n_vars in corpus]
+
+    dispatch.reset()
+    SolverStatistics().reset()
+    monkeypatch.setattr(args, "batch_solve", False)
+    sequential = [dispatch.solve(clauses, n_vars, 100000)[0]
+                  for clauses, n_vars in corpus]
+    assert sequential == batched
+    statistics = SolverStatistics()
+    assert statistics.batch_submitted == 0
+    assert statistics.batch_flushes == 0
+    assert dispatch._QUEUE.cache == {}
+    # repeats are NOT deduped on the legacy path
+    dispatch.solve(corpus[0][0], corpus[0][1], 100000)
+    dispatch.solve(corpus[0][0], corpus[0][1], 100000)
+    assert len(device.single_calls) == 6 + 6 + 2
+
+
+@pytest.mark.slow
+def test_real_device_batch_parity():
+    """The one real-XLA batch solve: shape-bucketed vmapped verdicts match
+    the pure-Python DPLL on a seeded corpus (small chunk/probes keep the
+    compile in seconds)."""
+    rng = random.Random(7)
+    corpus = [_random_cnf(rng, n_vars=3, n_clauses=5) for _ in range(6)]
+    corpus.append(([[1], [-1]], 1))  # one guaranteed UNSAT
+    results = jax_solver.solve_cnf_device_batch(
+        corpus, n_probes=4, max_steps=4000, chunk=8)
+    for (clauses, n_vars), (status, model) in zip(corpus, results):
+        expected_status, _ = sat.solve_cnf_python(clauses, n_vars)
+        assert status == expected_status
+        if status == sat.SAT:
+            assert _satisfies(clauses, model)
+    assert SolverStatistics().batch_bucket_shapes

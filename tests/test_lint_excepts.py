@@ -75,3 +75,38 @@ def test_ignores_acceptable_handlers(tmp_path, body):
     ok = tmp_path / "ok.py"
     ok.write_text(body)
     assert check_excepts.check_file(str(ok)) == []
+
+
+@pytest.mark.parametrize("call", [
+    "jax_solver.solve_cnf_device(clauses, n_vars)",
+    "solve_cnf_device(clauses, n_vars)",
+    "jax_solver.solve_cnf_device_batch(queries)",
+])
+def test_detects_dispatch_bypass(tmp_path, call):
+    """Rule 2 fires on direct device-solver calls, bare or attribute-form."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(f"def f(clauses, n_vars, queries):\n    return {call}\n")
+    violations = check_excepts.check_device_calls(str(bad))
+    assert len(violations) == 1
+    assert "dispatch" in violations[0][2]
+
+
+def test_dispatch_bypass_allows_owning_files(tmp_path):
+    """References that are not calls (monkeypatch targets, imports) pass,
+    and the two owning files are exempt."""
+    ok = tmp_path / "ok.py"
+    ok.write_text("from mythril_tpu.parallel.jax_solver import "
+                  "solve_cnf_device\nfn = solve_cnf_device\n")
+    assert check_excepts.check_device_calls(str(ok)) == []
+    for relpath in check_excepts.DEVICE_CALLERS:
+        path = os.path.join(check_excepts.REPO_ROOT, relpath)
+        assert os.path.exists(path), f"stale DEVICE_CALLERS entry {relpath}"
+        assert check_excepts.check_device_calls(path) == []
+
+
+def test_no_dispatch_bypass_in_tree():
+    """The whole package is clean: every device solve goes through
+    dispatch.submit()/solve()."""
+    violations = [v for v in check_excepts.run() if "bypasses" in v[2]]
+    assert not violations, "\n".join(
+        f"{path}:{lineno}: {detail}" for path, lineno, detail in violations)

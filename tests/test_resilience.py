@@ -27,12 +27,16 @@ UNSAT_CNF = ([[1], [-1]], 1, sat.UNSAT)
 
 @pytest.fixture(autouse=True)
 def _fresh(monkeypatch):
+    from mythril_tpu.smt.solver import dispatch
+
     resilience.reset()
     SolverStatistics().reset()
+    dispatch.reset()  # the batch layer's verdict cache must not leak across tests
     monkeypatch.setattr(args, "device_crosscheck", 0)
     yield
     resilience.reset()
     SolverStatistics().reset()
+    dispatch.reset()
 
 
 # -- taxonomy -------------------------------------------------------------------------
@@ -339,10 +343,14 @@ def test_inject_device_oom_analysis_completes_via_host_ladder(monkeypatch):
     from mythril_tpu.parallel import jax_solver
 
     # after the injected failure, the remaining device queries answer
-    # UNKNOWN (oversize-style fallback) — never a real device solve
+    # UNKNOWN (oversize-style fallback) — never a real device solve, on
+    # either the single-query or the batched dispatch route
     monkeypatch.setattr(jax_solver, "solve_cnf_device",
                         lambda clauses, n_vars, **kw: (jax_solver.UNKNOWN,
                                                        None))
+    monkeypatch.setattr(jax_solver, "solve_cnf_device_batch",
+                        lambda queries, **kw: [(jax_solver.UNKNOWN, None)
+                                               for _ in queries])
     modules = ["AccidentallyKillable"]
     baseline = _analyze(2, modules)
     assert sorted(i.swc_id for i in baseline) == ["106"]

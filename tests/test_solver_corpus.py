@@ -118,12 +118,22 @@ def test_device_backend_issue_parity_smoke(monkeypatch):
     from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
 
     original = jax_solver.solve_cnf_device
+    original_batch = jax_solver.solve_cnf_device_batch
 
     def tiny_cap(clauses, n_vars, **kwargs):
         kwargs["clause_cap"] = 8
         return original(clauses, n_vars, **kwargs)
 
+    def tiny_cap_batch(queries, **kwargs):
+        kwargs["clause_cap"] = 8
+        return original_batch(queries, **kwargs)
+
+    # both wrappers override the clause_cap kwarg the dispatch layer passes;
+    # DEFAULT_CLAUSE_CAP itself must stay untouched — the incremental cone
+    # extractor reads it at call time, and shrinking it would make every
+    # cone extraction return None before the device lane is ever consulted
     monkeypatch.setattr(jax_solver, "solve_cnf_device", tiny_cap)
+    monkeypatch.setattr(jax_solver, "solve_cnf_device_batch", tiny_cap_batch)
     statistics = SolverStatistics()
     statistics.reset()
     host, device = _issue_parity(

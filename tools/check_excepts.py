@@ -1,18 +1,27 @@
 #!/usr/bin/env python
-"""Lint: no new silent blanket exception swallows in the solver/device stack.
+"""Lint: no new silent blanket exception swallows in the solver/device stack,
+and no device-solver calls that bypass the batched dispatch layer.
 
-Scans `mythril_tpu/smt/` and `mythril_tpu/parallel/` for `except` handlers
-that are BOTH broad (bare `except:`, `except Exception:`, or
-`except BaseException:`) AND silent (a body of only `pass`/`continue`/`...`).
-A handler like that erases the entire failure story the resilience subsystem
-exists to tell (support/resilience.py: every backend failure must be
-classified, logged, and counted) — it is exactly the pattern ISSUE 2
-replaced at smt/solver/solver.py:48.
+Rule 1 — silent swallows: scans `mythril_tpu/smt/` and `mythril_tpu/parallel/`
+for `except` handlers that are BOTH broad (bare `except:`,
+`except Exception:`, or `except BaseException:`) AND silent (a body of only
+`pass`/`continue`/`...`). A handler like that erases the entire failure story
+the resilience subsystem exists to tell (support/resilience.py: every backend
+failure must be classified, logged, and counted) — it is exactly the pattern
+ISSUE 2 replaced at smt/solver/solver.py:48.
 
 Audited survivors live in ALLOWLIST, keyed (file, enclosing def): sites
 where swallowing is the correct behavior (e.g. a __del__ finalizer, where
 raising during interpreter teardown is worse than any leak). Add a new
 entry only with a comment defending it.
+
+Rule 2 — dispatch bypass: scans all of `mythril_tpu/` for calls to
+`solve_cnf_device` / `solve_cnf_device_batch` outside
+smt/solver/dispatch.py (the batching queue that owns the resilience
+contract: one breaker fire per batch, verdict caching, crosscheck sampling)
+and parallel/jax_solver.py (the implementation itself). A direct call skips
+the circuit breaker, the verdict cache, and the batch statistics — every
+caller must go through `dispatch.submit()`/`dispatch.solve()`.
 
 Run directly (`python tools/check_excepts.py`) or via the tier-1 suite
 (tests/test_lint_excepts.py). Exit status 1 on violations.
@@ -39,6 +48,18 @@ ALLOWLIST = {
     # cache (or read-only home dirs) must not break import of the package
     ("mythril_tpu/parallel/__init__.py", "_enable_persistent_cache"),
 }
+
+#: device-solver entry points that must only be reached via the dispatch queue
+DEVICE_ENTRYPOINTS = ("solve_cnf_device", "solve_cnf_device_batch")
+
+#: the only files allowed to call DEVICE_ENTRYPOINTS directly (repo-relative)
+DEVICE_CALLERS = {
+    "mythril_tpu/smt/solver/dispatch.py",
+    "mythril_tpu/parallel/jax_solver.py",
+}
+
+#: rule-2 scan root: the whole package, not just SCAN_DIRS
+DEVICE_SCAN_DIR = "mythril_tpu"
 
 _BROAD = ("Exception", "BaseException")
 
@@ -106,6 +127,34 @@ def check_file(path: str) -> List[Tuple[str, int, str]]:
     return violations
 
 
+def check_device_calls(path: str) -> List[Tuple[str, int, str]]:
+    """Rule 2: direct `solve_cnf_device[_batch](...)` calls outside the
+    dispatch layer. Returns violations as (relpath, lineno, detail)."""
+    relpath = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+    if relpath in DEVICE_CALLERS:
+        return []
+    with open(path, "r", encoding="utf-8") as handle:
+        tree = ast.parse(handle.read(), filename=path)
+    violations = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        if name not in DEVICE_ENTRYPOINTS:
+            continue
+        violations.append((
+            relpath, node.lineno,
+            f"direct {name}() call bypasses the batched dispatch layer "
+            "(breaker, verdict cache, crosscheck sampling) — go through "
+            "smt/solver/dispatch.submit()/solve() instead"))
+    return violations
+
+
 def run() -> List[Tuple[str, int, str]]:
     violations = []
     for scan_dir in SCAN_DIRS:
@@ -115,6 +164,12 @@ def run() -> List[Tuple[str, int, str]]:
                 if filename.endswith(".py"):
                     violations.extend(
                         check_file(os.path.join(dirpath, filename)))
+    base = os.path.join(REPO_ROOT, DEVICE_SCAN_DIR)
+    for dirpath, _, filenames in os.walk(base):
+        for filename in sorted(filenames):
+            if filename.endswith(".py"):
+                violations.extend(
+                    check_device_calls(os.path.join(dirpath, filename)))
     return violations
 
 

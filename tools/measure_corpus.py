@@ -39,11 +39,17 @@ DEFAULT_CONTRACTS = [
 ]
 
 
-def measure(engine: str, budget: int, contracts):
+def measure(engine: str, budget: int, contracts, solver: str = "cdcl",
+            batch_solve: bool = True):
     from mythril_tpu.analysis.security import (fire_lasers,
                                                reset_callback_modules)
     from mythril_tpu.analysis.symbolic import SymExecWrapper
     from mythril_tpu.smt.solver.solver import reset_solver_backend
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+    from mythril_tpu.support.support_args import args as engine_args
+
+    engine_args.solver = solver
+    engine_args.batch_solve = batch_solve
 
     if engine == "tpu":
         # compile warm-up on a trivial contract so the first measured
@@ -67,6 +73,7 @@ def measure(engine: str, budget: int, contracts):
     for name in contracts:
         reset_callback_modules()
         reset_solver_backend()
+        SolverStatistics().reset()
         start = time.perf_counter()
         import types
 
@@ -96,12 +103,18 @@ def measure(engine: str, budget: int, contracts):
             "n_issues": len(issues),
             "forks_on_device": getattr(laser, "frontier_forks", 0),
         }
+        if solver == "jax":
+            # batch-dispatch amortization per contract (occupancy, cache
+            # hit rate, buckets compiled) — bench.py forwards the rollup
+            results[name]["solver_batch"] = \
+                SolverStatistics().batch_metrics()
         print(json.dumps({"contract": name, "engine": engine,
                           **results[name]}), flush=True)
     return results
 
 
-def measure_parallel(engine: str, budget: int, contracts, n_workers: int):
+def measure_parallel(engine: str, budget: int, contracts, n_workers: int,
+                     solver: str = "cdcl", batch_solve: bool = True):
     """Contract-granularity fan-out: one subprocess per shard (round-robin),
     merged results. Per-contract process isolation means one contract's
     crash/hang cannot poison the sweep — the distributed tier's contract
@@ -117,10 +130,13 @@ def measure_parallel(engine: str, budget: int, contracts, n_workers: int):
         out = tempfile.NamedTemporaryFile(
             suffix=f".shard{rank}.json", delete=False)
         out.close()
-        procs.append((out.name, subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__),
-             "--engine", engine, "--budget", str(budget),
-             "--contracts", ",".join(shard), "--out", out.name])))
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--engine", engine, "--budget", str(budget),
+               "--contracts", ",".join(shard), "--out", out.name,
+               "--solver", solver]
+        if not batch_solve:
+            cmd.append("--no-batch-solve")
+        procs.append((out.name, subprocess.Popen(cmd)))
     results = {}
     for out_name, proc in procs:
         proc.wait()
@@ -141,6 +157,13 @@ def main():
     parser.add_argument("--budget", type=int, default=90)
     parser.add_argument("--contracts", default=None)
     parser.add_argument("--out", default=None)
+    parser.add_argument("--solver", default="cdcl", choices=["cdcl", "jax"],
+                        help="SAT backend for the sweep (--solver jax "
+                        "exercises the batched device dispatch and records "
+                        "solver_batch metrics per contract)")
+    parser.add_argument("--no-batch-solve", action="store_true",
+                        help="disable the batched device SAT dispatch "
+                        "(A/B: one launch per query)")
     parser.add_argument(
         "--parallel", type=int, default=0, metavar="N",
         help="fan the sweep over N worker PROCESSES, each analyzing a "
@@ -152,11 +175,14 @@ def main():
     args = parser.parse_args()
     contracts = (args.contracts.split(",") if args.contracts
                  else DEFAULT_CONTRACTS)
+    batch_solve = not args.no_batch_solve
     if args.parallel > 1:
         results = measure_parallel(args.engine, args.budget, contracts,
-                                   args.parallel)
+                                   args.parallel, solver=args.solver,
+                                   batch_solve=batch_solve)
     else:
-        results = measure(args.engine, args.budget, contracts)
+        results = measure(args.engine, args.budget, contracts,
+                          solver=args.solver, batch_solve=batch_solve)
     rates = [r["states_per_sec"] for r in results.values()
              if "states_per_sec" in r]
     summary = {
@@ -168,6 +194,30 @@ def main():
         "total_swc_findings": sum(r.get("n_issues", 0)
                                   for r in results.values()),
     }
+    if args.solver == "jax":
+        summary["solver"] = args.solver
+        summary["batch_solve"] = batch_solve
+        # whole-sweep rollup of the per-contract dispatch counters so the
+        # corpus JSON (and bench.py's corpus extras) carries one
+        # cache-hit/occupancy summary
+        per = [r["solver_batch"] for r in results.values()
+               if "solver_batch" in r]
+        submitted = sum(p["submitted"] for p in per)
+        flushes = sum(p["flushes"] for p in per)
+        flushed = sum(p["flushed_queries"] for p in per)
+        summary["solver_batch"] = {
+            "submitted": submitted,
+            "cache_hits": sum(p["cache_hits"] for p in per),
+            "dedup_hits": sum(p["dedup_hits"] for p in per),
+            "flushes": flushes,
+            "flushed_queries": flushed,
+            "occupancy": round(flushed / flushes, 2) if flushes else 0.0,
+            "cache_hit_rate": round(
+                sum(p["cache_hits"] for p in per) / submitted, 3)
+            if submitted else 0.0,
+            "buckets_compiled": max((p["buckets_compiled"] for p in per),
+                                    default=0),
+        }
     out_path = args.out or os.path.join(
         REPO, f"corpus_{args.engine}.json")
     with open(out_path, "w") as handle:
