@@ -6,18 +6,19 @@ from __future__ import annotations
 
 import configparser
 import logging
-import os
 from pathlib import Path
 from typing import Optional
 
 from ..ethereum.rpc import EthJsonRpc
+
+from ..support import tpu_config
 
 log = logging.getLogger(__name__)
 
 
 class MythrilConfig:
     def __init__(self, config_path: Optional[str] = None):
-        self.mythril_dir = Path(os.environ.get(
+        self.mythril_dir = Path(tpu_config.get_str(
             "MYTHRIL_TPU_DIR", Path.home() / ".mythril-tpu"))
         self.config_path = Path(config_path) if config_path else \
             self.mythril_dir / "config.ini"
@@ -39,7 +40,7 @@ class MythrilConfig:
     # -- RPC selection ---------------------------------------------------------------
     def set_api_rpc(self, rpc: Optional[str] = None,
                     rpctls: bool = False) -> None:
-        rpc = rpc or os.environ.get("MYTHRIL_TPU_RPC") or \
+        rpc = rpc or tpu_config.get_str("MYTHRIL_TPU_RPC") or \
             self.config.get("defaults", "dynamic_loading",
                             fallback="infura-mainnet")
         self.eth = EthJsonRpc.from_preset(rpc, rpctls)

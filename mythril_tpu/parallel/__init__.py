@@ -29,7 +29,9 @@ jax.config.update("jax_enable_x64", True)
 
 
 def _enable_persistent_cache() -> None:
-    cache_dir = os.environ.get(
+    from ..support import tpu_config
+
+    cache_dir = tpu_config.get_str(
         "MYTHRIL_TPU_JAX_CACHE",
         os.path.join(os.path.expanduser("~"), ".cache", "mythril_tpu_jax"))
     try:

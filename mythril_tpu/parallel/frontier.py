@@ -46,6 +46,7 @@ from ..core.state.global_state import GlobalState
 from ..exceptions import UnsatError
 from ..smt import Bool, Extract, symbol_factory
 from ..smt import terms as T
+from ..support import tpu_config
 from . import arena as A
 from . import symstep
 from . import words
@@ -348,8 +349,8 @@ class _Frontier:
         #: escaping lane's path conditions here did strictly MORE solver work
         #: than the host ever does — it was 85x of the round-4 bench wall.
         #: Feasibility is decided where the host decides it: at issue time.
-        self.check_escapes = os.environ.get(
-            "MYTHRIL_TPU_CHECK_ESCAPES") == "1"
+        self.check_escapes = tpu_config.get_flag(
+            "MYTHRIL_TPU_CHECK_ESCAPES")
         #: (signed cond id, ctx index) -> Bool (see _cond_bools)
         self._cond_memo: Dict[Tuple[int, int], Bool] = {}
         #: drained-but-unmaterialized row blocks: [rows_state, rows_planes,
@@ -361,8 +362,8 @@ class _Frontier:
         #: escape rows accumulate in the DEVICE buffer until this many
         #: wait, then the host drains them in one bandwidth-sized light
         #: transfer
-        self.drain_batch = int(os.environ.get(
-            "MYTHRIL_TPU_DRAIN_BATCH", max(4 * n_lanes, 1024)))
+        self.drain_batch = tpu_config.get_int(
+            "MYTHRIL_TPU_DRAIN_BATCH", max(4 * n_lanes, 1024))
         #: host overflow tier: raw rows land here only when the DEVICE
         #: scheduler cannot hold them (sibling stack full at total
         #: deadlock) or on checkpoint/resume; they re-seed into DEAD lanes
@@ -377,10 +378,8 @@ class _Frontier:
         self.stack_pushes = 0  # device DFS-stack siblings pushed
         self.stack_pops = 0    # device DFS-stack siblings reseeded
         #: scheduler pool byte budgets (HBM)
-        self.stack_bytes = int(os.environ.get(
-            "MYTHRIL_TPU_STACK_BYTES", 3 << 30))
-        self.esc_bytes = int(os.environ.get(
-            "MYTHRIL_TPU_ESC_BYTES", 1 << 30))
+        self.stack_bytes = tpu_config.get_int("MYTHRIL_TPU_STACK_BYTES")
+        self.esc_bytes = tpu_config.get_int("MYTHRIL_TPU_ESC_BYTES")
 
     def _harena(self, used=None, used_const=None) -> A.HostArena:
         """The persistent incremental host mirror of the arena (term memo
@@ -534,17 +533,17 @@ class _Frontier:
 
         from ..core.time_handler import time_handler
 
-        max_steps = int(os.environ.get("MYTHRIL_TPU_MAX_STEPS", MAX_STEPS))
-        chunk = int(os.environ.get("MYTHRIL_TPU_CHUNK", CHUNK))
+        max_steps = tpu_config.get_int("MYTHRIL_TPU_MAX_STEPS", MAX_STEPS)
+        chunk = tpu_config.get_int("MYTHRIL_TPU_CHUNK", CHUNK)
         # env vars keep working; `analyze --checkpoint/--resume` rides the
         # laser's host-phase paths with a .device suffix beside the pickle
         host_ckpt = getattr(self.laser, "checkpoint_path", None)
         # NOT laser.resume_path: the host-resume logic consumes that before
         # the frontier runs (svm.execute_transactions)
         host_resume = getattr(self.laser, "_device_resume_path", None)
-        checkpoint_path = os.environ.get("MYTHRIL_TPU_CHECKPOINT") \
+        checkpoint_path = tpu_config.get_str("MYTHRIL_TPU_CHECKPOINT") \
             or (f"{host_ckpt}.device" if host_ckpt else None)
-        resume_path = os.environ.get("MYTHRIL_TPU_RESUME") \
+        resume_path = tpu_config.get_str("MYTHRIL_TPU_RESUME") \
             or (f"{host_resume}.device" if host_resume else None)
         if resume_path:
             if not resume_path.endswith(".npz"):
@@ -557,7 +556,7 @@ class _Frontier:
                 except Exception as error:  # corrupt file / identity mismatch
                     log.warning("cannot resume from %s (%s); starting the "
                                 "device phase fresh", resume_path, error)
-                os.environ.pop("MYTHRIL_TPU_RESUME", None)  # consume once
+                tpu_config.consume("MYTHRIL_TPU_RESUME")  # consume once
                 self.laser._device_resume_path = None
         # ONE jit signature: numpy rows written by host services must be
         # re-canonicalized to device arrays, or the next fused call sees a
@@ -593,7 +592,7 @@ class _Frontier:
         # the device may consume at most this fraction of the remaining
         # execution budget: the rest belongs to the host continuation
         # (detector hooks, deferred-row materialization, next-tx seeding)
-        frac = float(os.environ.get("MYTHRIL_TPU_DEVICE_FRAC", "0.85"))
+        frac = tpu_config.get_float("MYTHRIL_TPU_DEVICE_FRAC")
         device_deadline = time_handler.time_remaining() * min(max(frac, 0.05),
                                                               1.0)
         import time as time_module
@@ -759,7 +758,7 @@ class _Frontier:
         import jax
 
         devices = jax.devices()
-        flag = os.environ.get("MYTHRIL_TPU_SHARD")
+        flag = tpu_config.get_raw("MYTHRIL_TPU_SHARD")
         if flag == "1" and len(devices) > 1 and self.n_lanes % len(devices):
             log.warning(
                 "MYTHRIL_TPU_SHARD=1 but %d lanes do not divide across %d "
@@ -1547,7 +1546,7 @@ def execute_message_call_tpu(laser_evm, callee_address,
 
     import os
 
-    lane_budget = int(os.environ.get("MYTHRIL_TPU_LANES", DEFAULT_LANES))
+    lane_budget = tpu_config.get_int("MYTHRIL_TPU_LANES", DEFAULT_LANES)
     frontier = _Frontier(laser_evm,
                          n_lanes=max(lane_budget, 2 * len(seeds)))
     state, planes = frontier.seed(seeds)
@@ -1567,7 +1566,7 @@ def execute_message_call_tpu(laser_evm, callee_address,
         laser_evm, "frontier_lane_steps", 0) + frontier.lane_steps
     laser_evm.frontier_forks = getattr(
         laser_evm, "frontier_forks", 0) + frontier.forks
-    if os.environ.get("MYTHRIL_TPU_SKIP_HOST_DRAIN"):
+    if tpu_config.get_flag("MYTHRIL_TPU_SKIP_HOST_DRAIN"):
         # warm-up aid (bench.py): compile/load the device executable without
         # paying a full host continuation of the materialized states
         del laser_evm.work_list[:]

@@ -324,12 +324,12 @@ def solve_cnf_device(clauses: List[List[int]], n_vars: int,
     # tile shard per step. Same gating as the frontier's lane sharding:
     # MYTHRIL_TPU_SHARD=1 forces on, =0 off, default on for real
     # accelerator meshes only.
-    import os
-
     import jax
 
+    from ..support import tpu_config
+
     devices = jax.devices()
-    flag = os.environ.get("MYTHRIL_TPU_SHARD")
+    flag = tpu_config.get_raw("MYTHRIL_TPU_SHARD")
     n_devices = 1
     if len(devices) > 1 and flag != "0" \
             and (flag == "1" or devices[0].platform != "cpu"):

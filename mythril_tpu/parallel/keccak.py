@@ -49,6 +49,10 @@ _ROT_TABLE = [
 for _x in range(5):
     for _y in range(5):
         _ROTATIONS[_x + 5 * _y] = _ROT_TABLE[_x][_y]
+# plain-int view for use inside traced code: _rotl64's shift amount is a
+# static Python int, and an int(np_scalar) conversion inside the traced
+# round function reads as a device sync to tpu-lint R3
+_ROTATIONS_PY = [int(_r) for _r in _ROTATIONS]
 
 
 def _rotl64(lo, hi, n):
@@ -99,7 +103,7 @@ def _keccak_round(lo, hi, rc_lo, rc_hi):
             src = x + 5 * y
             dst = y + 5 * ((2 * x + 3 * y) % 5)
             b_lo[dst], b_hi[dst] = _rotl64(
-                lo[..., src], hi[..., src], int(_ROTATIONS[src]))
+                lo[..., src], hi[..., src], _ROTATIONS_PY[src])
 
     # chi
     new_lo, new_hi = [], []

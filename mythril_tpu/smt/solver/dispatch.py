@@ -48,7 +48,6 @@ launch — the legacy `_device_solve` path, kept bit-identical for A/B).
 from __future__ import annotations
 
 import logging
-import os
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
@@ -57,25 +56,30 @@ log = logging.getLogger(__name__)
 
 from . import sat
 from .solver_statistics import SolverStatistics
+from ...support import tpu_config
 
 Verdict = Tuple[int, Optional[List[bool]]]
 CanonicalKey = Tuple[int, Tuple[Tuple[int, ...], ...]]
 
 
 def flush_threshold() -> int:
-    """Queue length that forces a flush (MYTHRIL_TPU_BATCH_FLUSH)."""
-    return max(1, int(os.environ.get("MYTHRIL_TPU_BATCH_FLUSH", "16")))
+    """Queue length that forces a flush (MYTHRIL_TPU_BATCH_FLUSH).
+
+    Read through the tpu_config registry at CALL time, never snapshotted
+    at queue construction: tests reset() the queue before monkeypatching
+    the env, so an eager read would make overrides order-dependent."""
+    return max(1, tpu_config.get_int("MYTHRIL_TPU_BATCH_FLUSH"))
 
 
 def flush_age_ms() -> float:
     """Oldest-entry age that forces a flush at the next submit
     (MYTHRIL_TPU_BATCH_AGE_MS)."""
-    return float(os.environ.get("MYTHRIL_TPU_BATCH_AGE_MS", "50"))
+    return tpu_config.get_float("MYTHRIL_TPU_BATCH_AGE_MS")
 
 
 def cache_size() -> int:
     """Verdict-cache bound (MYTHRIL_TPU_VERDICT_CACHE)."""
-    return max(1, int(os.environ.get("MYTHRIL_TPU_VERDICT_CACHE", "4096")))
+    return max(1, tpu_config.get_int("MYTHRIL_TPU_VERDICT_CACHE"))
 
 
 def canonicalize(clauses: List[List[int]], n_vars: int) -> CanonicalKey:

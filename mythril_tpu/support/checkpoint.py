@@ -31,6 +31,8 @@ import pickle
 import sys
 from typing import Optional
 
+from . import tpu_config
+
 log = logging.getLogger(__name__)
 
 FORMAT_VERSION = 1
@@ -65,8 +67,8 @@ def fsync_replace(tmp: str, path: str) -> None:
 
 
 def checkpoint_state_interval() -> int:
-    return int(os.environ.get("MYTHRIL_TPU_CHECKPOINT_STATES",
-                              SAVE_INTERVAL_STATES))
+    return tpu_config.get_int("MYTHRIL_TPU_CHECKPOINT_STATES",
+                              SAVE_INTERVAL_STATES)
 
 
 def _collect_detector_state():

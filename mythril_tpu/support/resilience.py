@@ -35,8 +35,9 @@ starts with healthy backends.
 from __future__ import annotations
 
 import logging
-import os
 from typing import Callable, Dict, List, Optional, Tuple
+
+from . import tpu_config
 
 log = logging.getLogger(__name__)
 
@@ -269,10 +270,10 @@ class HealthRegistry:
     def backend(self, name: str) -> BackendHealth:
         health = self._backends.get(name)
         if health is None:
-            trip = int(os.environ.get("MYTHRIL_TPU_BREAKER_TRIP",
-                                      DEFAULT_TRIP_AFTER))
-            recover = int(os.environ.get("MYTHRIL_TPU_BREAKER_RECOVERY",
-                                         DEFAULT_RECOVERY_AFTER))
+            trip = tpu_config.get_int("MYTHRIL_TPU_BREAKER_TRIP",
+                                      DEFAULT_TRIP_AFTER)
+            recover = tpu_config.get_int("MYTHRIL_TPU_BREAKER_RECOVERY",
+                                         DEFAULT_RECOVERY_AFTER)
             health = BackendHealth(name, trip_after=trip,
                                    recovery_after=recover)
             self._backends[name] = health
@@ -362,7 +363,7 @@ def configure(spec: Optional[str]) -> None:
 def plan() -> FaultPlan:
     global _plan
     if _plan is None:
-        _plan = FaultPlan(os.environ.get("MYTHRIL_TPU_INJECT_FAULT"))
+        _plan = FaultPlan(tpu_config.get_str("MYTHRIL_TPU_INJECT_FAULT"))
         if _plan.active:
             log.warning("fault injection ACTIVE (env): %s", _plan.spec)
     return _plan
@@ -392,7 +393,7 @@ def device_wall_budget_ms() -> int:
     WALL_OVERRUN failure (0 disables the check). A sick backend often
     still answers — after minutes of recompile; overruns trip the breaker
     even when the verdict is usable."""
-    return int(os.environ.get("MYTHRIL_TPU_DEVICE_WALL_MS", 120_000))
+    return tpu_config.get_int("MYTHRIL_TPU_DEVICE_WALL_MS")
 
 
 def crosscheck_every() -> int:
@@ -404,7 +405,7 @@ def crosscheck_every() -> int:
     configured = getattr(args, "device_crosscheck", 0)
     if configured:
         return int(configured)
-    return int(os.environ.get("MYTHRIL_TPU_CROSSCHECK", 0))
+    return tpu_config.get_int("MYTHRIL_TPU_CROSSCHECK")
 
 
 def reset() -> None:

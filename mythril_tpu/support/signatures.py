@@ -19,6 +19,8 @@ from typing import List
 
 from ..utils.keccak import keccak256
 
+from . import tpu_config
+
 _COMMON_SIGNATURES = [
     "transfer(address,uint256)", "transferFrom(address,address,uint256)",
     "approve(address,uint256)", "balanceOf(address)", "totalSupply()",
@@ -33,7 +35,8 @@ _COMMON_SIGNATURES = [
 
 
 def _default_db_path() -> str:
-    base = os.environ.get("MYTHRIL_TPU_DIR", os.path.expanduser("~/.mythril_tpu"))
+    base = tpu_config.get_str("MYTHRIL_TPU_DIR",
+                              os.path.expanduser("~/.mythril_tpu"))
     os.makedirs(base, exist_ok=True)
     return os.path.join(base, "signatures.db")
 

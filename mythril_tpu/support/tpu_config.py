@@ -1,0 +1,193 @@
+"""Central registry for every ``MYTHRIL_TPU_*`` environment knob.
+
+Every knob the engine reads must be declared here — name, type, default,
+and a one-line docstring. The tpu-lint rule R5 (tools/lint/rules/env_knobs)
+fails the build on any ``os.environ``/``os.getenv`` read of an undeclared
+``MYTHRIL_TPU_*`` name, and on a README knob table that drifts from
+:func:`render_markdown_table`. The accessors below are the runtime half of
+the same contract: they raise ``KeyError`` for undeclared names, so a typo
+in a knob name is loud instead of silently returning the default.
+
+All accessors read ``os.environ`` at *call time* (never at import or
+construction time): tests monkeypatch knobs in arbitrary order relative to
+queue/frontier construction, and an import-time snapshot would make those
+overrides order-dependent (see tests/test_batch_dispatch.py's autouse
+fixture, which resets the dispatch queue *before* setting the env).
+
+This module must stay dependency-free (stdlib only): the lint framework
+loads it standalone, without importing jax or the rest of the package.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, NamedTuple, Optional
+
+
+class Knob(NamedTuple):
+    """One declared environment knob."""
+
+    name: str           #: full env-var name (MYTHRIL_TPU_*)
+    type: str           #: "int" | "float" | "str" | "flag"
+    default: object     #: static default, or None when unset/dynamic
+    doc: str            #: one-line description (rendered into the README)
+
+
+_KNOBS: List[Knob] = [
+    # -- device frontier / lockstep engine ---------------------------------------
+    Knob("MYTHRIL_TPU_LANES", "int", 128,
+         "Device lane count: vmapped EVM lanes per frontier phase."),
+    Knob("MYTHRIL_TPU_MAX_STEPS", "int", 4096,
+         "Per-transaction device step budget before host hand-over."),
+    Knob("MYTHRIL_TPU_CHUNK", "int", 64,
+         "Fused lockstep steps per device dispatch (one jit call)."),
+    Knob("MYTHRIL_TPU_DEVICE_FRAC", "float", 0.85,
+         "Fraction of the remaining wall budget the device phase may "
+         "consume; the rest is reserved for the host continuation."),
+    Knob("MYTHRIL_TPU_SHARD", "str", None,
+         "Lane-axis sharding: 1 forces on, 0 forces off; unset enables "
+         "it only on real multi-device accelerator meshes."),
+    Knob("MYTHRIL_TPU_SKIP_HOST_DRAIN", "flag", False,
+         "Bench warm-up aid: drop materialized states instead of running "
+         "the host continuation."),
+    Knob("MYTHRIL_TPU_CHECK_ESCAPES", "flag", False,
+         "Re-enable escape-time solver pruning (default off: feasibility "
+         "is decided at issue time, matching the host engine)."),
+    Knob("MYTHRIL_TPU_DRAIN_BATCH", "int", None,
+         "Escape rows buffered on device before one bulk host drain "
+         "(dynamic default: max(4 * n_lanes, 1024))."),
+    Knob("MYTHRIL_TPU_STACK_BYTES", "int", 3 << 30,
+         "HBM byte budget for the device DFS sibling stack pool."),
+    Knob("MYTHRIL_TPU_ESC_BYTES", "int", 1 << 30,
+         "HBM byte budget for the device escape-row buffer."),
+    Knob("MYTHRIL_TPU_CHECKPOINT", "str", None,
+         "Path for crash-safe device-phase checkpoints (.npz)."),
+    Knob("MYTHRIL_TPU_RESUME", "str", None,
+         "Checkpoint path to resume the device phase from; consumed once."),
+    Knob("MYTHRIL_TPU_JAX_CACHE", "str", None,
+         "Persistent XLA compilation cache directory (dynamic default: "
+         "~/.cache/mythril_tpu_jax)."),
+    # -- batched SAT dispatch ----------------------------------------------------
+    Knob("MYTHRIL_TPU_BATCH_FLUSH", "int", 16,
+         "Queued SAT queries that trigger a batched device flush."),
+    Knob("MYTHRIL_TPU_BATCH_AGE_MS", "float", 50.0,
+         "Max age (ms) a queued SAT query may wait before a flush."),
+    Knob("MYTHRIL_TPU_VERDICT_CACHE", "int", 4096,
+         "Entries in the canonical-CNF SAT/UNSAT verdict LRU cache."),
+    # -- resilience / failure domains --------------------------------------------
+    Knob("MYTHRIL_TPU_BREAKER_TRIP", "int", 3,
+         "Consecutive backend failures that trip the circuit breaker."),
+    Knob("MYTHRIL_TPU_BREAKER_RECOVERY", "int", 32,
+         "Skipped calls before a tripped breaker half-opens for a retry."),
+    Knob("MYTHRIL_TPU_INJECT_FAULT", "str", None,
+         "Deterministic fault-injection plan CLASS[:NTH] (tests/debug)."),
+    Knob("MYTHRIL_TPU_DEVICE_WALL_MS", "int", 120_000,
+         "Wall budget (ms) for one device solve before it counts as a "
+         "WALL_OVERRUN failure (0 disables)."),
+    Knob("MYTHRIL_TPU_CROSSCHECK", "int", 0,
+         "Re-decide every Nth device verdict on the host CDCL oracle "
+         "(0 = off)."),
+    # -- checkpoint / persistence -------------------------------------------------
+    Knob("MYTHRIL_TPU_CHECKPOINT_STATES", "int", 2000,
+         "Host-engine states executed between periodic checkpoint saves."),
+    Knob("MYTHRIL_TPU_DIR", "str", None,
+         "Data directory for the signature DB (dynamic default: "
+         "~/.mythril_tpu)."),
+    Knob("MYTHRIL_TPU_RPC", "str", None,
+         "Default RPC endpoint preset for dynamic loading."),
+    # -- test corpora -------------------------------------------------------------
+    Knob("MYTHRIL_TPU_VMTESTS", "str", None,
+         "Root of the ethereum/tests VMTests corpus for parity suites."),
+]
+
+REGISTRY: Dict[str, Knob] = {knob.name: knob for knob in _KNOBS}
+
+_UNSET = object()
+
+
+def declared(name: str) -> bool:
+    """True when `name` is a registered knob."""
+    return name in REGISTRY
+
+
+def _knob(name: str, expected_type: str) -> Knob:
+    knob = REGISTRY[name]  # KeyError on undeclared names is the contract
+    if knob.type != expected_type:
+        raise TypeError(
+            f"{name} is declared as {knob.type!r}, not {expected_type!r}")
+    return knob
+
+
+def get_raw(name: str) -> Optional[str]:
+    """The raw env string for a declared knob (None when unset)."""
+    REGISTRY[name]  # KeyError on undeclared names is the contract
+    return os.environ.get(name)
+
+
+def get_int(name: str, default: object = _UNSET) -> Optional[int]:
+    """Call-time int read; `default` overrides the registry default
+    (used for dynamic defaults like MYTHRIL_TPU_DRAIN_BATCH)."""
+    knob = _knob(name, "int")
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return knob.default if default is _UNSET else default
+    return int(raw)
+
+
+def get_float(name: str, default: object = _UNSET) -> Optional[float]:
+    knob = _knob(name, "float")
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return knob.default if default is _UNSET else default
+    return float(raw)
+
+
+def get_str(name: str, default: object = _UNSET) -> Optional[str]:
+    knob = _knob(name, "str")
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return knob.default if default is _UNSET else default
+    return raw
+
+
+def get_flag(name: str, default: object = _UNSET) -> bool:
+    """Boolean knob: unset -> default; "0"/""/"false"/"no"/"off" -> False;
+    anything else -> True."""
+    knob = _knob(name, "flag")
+    raw = os.environ.get(name)
+    if raw is None:
+        return bool(knob.default if default is _UNSET else default)
+    return raw.lower() not in ("", "0", "false", "no", "off")
+
+
+def consume(name: str) -> Optional[str]:
+    """Read a declared knob and remove it from the environment (pop-once
+    semantics, e.g. MYTHRIL_TPU_RESUME)."""
+    REGISTRY[name]  # KeyError on undeclared names is the contract
+    return os.environ.pop(name, None)
+
+
+def _fmt_default(knob: Knob) -> str:
+    if knob.default is None:
+        return "*(unset)*"
+    if knob.type == "flag":
+        return "`1`" if knob.default else "`0`"
+    return f"`{knob.default}`"
+
+
+def render_markdown_table() -> str:
+    """The README env-knob table; lint R5 fails when the README section
+    between the knob-table markers drifts from this rendering."""
+    lines = [
+        "| Knob | Type | Default | Meaning |",
+        "| --- | --- | --- | --- |",
+    ]
+    for knob in _KNOBS:
+        lines.append(
+            f"| `{knob.name}` | {knob.type} | {_fmt_default(knob)} "
+            f"| {knob.doc} |")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(render_markdown_table())

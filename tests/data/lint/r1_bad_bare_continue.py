@@ -1,0 +1,18 @@
+"""R1 bad fixture: bare except whose body is only `continue`, plus a
+module-level broad `...` swallow."""
+
+
+def poll(sources):
+    results = []
+    for src in sources:
+        try:
+            results.append(src.read())
+        except:  # noqa: E722 - deliberately bare for the fixture
+            continue
+    return results
+
+
+try:
+    import fictional_accelerator_backend  # noqa: F401
+except BaseException:
+    ...

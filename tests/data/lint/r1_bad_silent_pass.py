@@ -1,0 +1,9 @@
+"""R1 bad fixture: broad + silent handler inside a function."""
+
+
+def drain(queue):
+    for item in queue:
+        try:
+            item.flush()
+        except Exception:
+            pass

@@ -1,0 +1,7 @@
+"""R2 bad fixture: attribute-style call to the batched entry point."""
+
+from mythril_tpu.parallel import jax_solver
+
+
+def decide_all(cnfs):
+    return jax_solver.solve_cnf_device_batch(cnfs)

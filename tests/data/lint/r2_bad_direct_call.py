@@ -1,0 +1,7 @@
+"""R2 bad fixture: plain-name call to the device solver entry point."""
+
+from mythril_tpu.parallel.jax_solver import solve_cnf_device
+
+
+def decide(cnf):
+    return solve_cnf_device(cnf)

@@ -1,0 +1,19 @@
+"""R3 bad fixture: Python branching on a traced value inside a vmapped
+function, plus unjustified explicit sync sites in the host driver."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.vmap
+def step(lane):
+    if jnp.any(lane > 0):
+        return lane - 1
+    return lane
+
+
+def drive(lanes):
+    out = step(lanes)
+    while int(jnp.sum(out)) > 0:
+        out = step(out)
+    return jax.device_get(out)

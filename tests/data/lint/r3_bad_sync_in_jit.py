@@ -1,0 +1,24 @@
+"""R3 bad fixture: implicit device->host syncs inside traced functions —
+a .item() under a @jax.jit decorator and a host-numpy call inside a
+function traced via the jax.jit(...) wrapper form."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def worst_lane(scores):
+    return scores.argmin().item()
+
+
+def _normalize(x):
+    total = np.sum(x)
+    return x / total
+
+
+normalize = jax.jit(_normalize)
+
+
+def run(scores):
+    return normalize(jnp.asarray(scores)), worst_lane(jnp.asarray(scores))

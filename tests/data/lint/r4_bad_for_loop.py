@@ -1,0 +1,9 @@
+"""R4 bad fixture: a table-densification for-loop carrying one real
+mnemonic and one typo'd one."""
+
+HANDLERS = {}
+
+
+def register(table):
+    for name in ("ADD", "MYSTERYOP"):
+        HANDLERS[name] = table.lookup(name)

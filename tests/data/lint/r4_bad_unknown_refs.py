@@ -1,0 +1,14 @@
+"""R4 bad fixture: interpreter-style dispatch referencing mnemonics that
+do not exist in ops/opcodes.py — the comparisons can never match."""
+
+
+def dispatch(op, O, state):
+    if is_op(op, "BOGUSADD"):
+        return state + 1
+    if op == O["NOTANOP"]:
+        return state - 1
+    return state
+
+
+def is_op(op, name):
+    return False

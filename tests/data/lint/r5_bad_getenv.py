@@ -1,0 +1,9 @@
+"""R5 bad fixture: undeclared knobs via os.getenv and a setdefault
+write (setting an undeclared knob is the same typo one step earlier)."""
+
+import os
+
+
+def configure():
+    os.environ.setdefault("MYTHRIL_TPU_MISSPELLED", "1")
+    return os.getenv("MYTHRIL_TPU_NOT_A_KNOB", "1")

@@ -1,0 +1,10 @@
+"""R5 bad fixture: reads of MYTHRIL_TPU_* names missing from the
+tpu_config registry, via .get and subscript access."""
+
+import os
+
+TURBO = os.environ.get("MYTHRIL_TPU_TURBO", "0")
+
+
+def speed():
+    return os.environ["MYTHRIL_TPU_SPEED"]

@@ -1,0 +1,8 @@
+"""R5 clean fixture: declared-knob reads and non-MYTHRIL_TPU_* env
+access are both fine."""
+
+import os
+
+LANES = os.environ.get("MYTHRIL_TPU_LANES", "128")
+HOME = os.environ.get("HOME", "/root")
+SHELL = os.getenv("SHELL")

@@ -1,0 +1,434 @@
+"""Tier-1 wiring + unit tests for tpu-lint (tools/lint/).
+
+Three layers:
+
+* the tree itself is clean under every rule (the tier-1 gate),
+* each rule fires on its bad fixtures under tests/data/lint/ and stays
+  quiet on the clean ones,
+* the framework plumbing — discovery, baseline hygiene, CLI exit codes,
+  and the tools/check_excepts.py back-compat shim — behaves as
+  documented.
+
+Everything here is AST-level and stdlib-only (no jax import), so the
+whole module runs in a few seconds under JAX_PLATFORMS=cpu.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+FIXTURE_DIR = os.path.join(REPO_ROOT, "tests", "data", "lint")
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.lint import (Baseline, LintContext, LintRule,  # noqa: E402
+                        RuleDiscovery, Violation, run_lint)
+from tools.lint.rules import (dispatch_bypass, env_knobs,  # noqa: E402
+                              opcode_semantics, silent_excepts,
+                              trace_safety)
+
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5")
+
+
+def _tree(text, filename="<fixture>"):
+    return ast.parse(text, filename=filename)
+
+
+def _fixture_tree(name):
+    path = os.path.join(FIXTURE_DIR, name)
+    with open(path, encoding="utf-8") as handle:
+        return ast.parse(handle.read(), filename=path)
+
+
+def _run_cli(*argv, check=False):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", *argv],
+        cwd=REPO_ROOT, capture_output=True, text=True)
+    if check:
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc
+
+
+# -- the tier-1 gate: the shipped tree is clean --------------------------------------
+
+
+def test_tree_is_clean_under_all_rules():
+    report = run_lint()
+    assert report.ok, (
+        "tpu-lint found problems:\n"
+        + "\n".join(f"{v.path}:{v.lineno}: [{v.rule}] {v.detail}"
+                    for v in report.violations)
+        + "".join(f"\nstale baseline entry: {k}" for k in report.stale_keys)
+        + "".join(f"\nunjustified baseline entry: {k}"
+                  for k in report.unjustified_keys))
+
+
+def test_every_baseline_entry_is_exercised():
+    """Every baseline entry is hit by a live violation (none stale) and
+    carries a real justification — run_lint enforces both, so a clean
+    report with a non-empty suppressed list proves the baseline earns
+    its keep."""
+    report = run_lint()
+    assert report.ok
+    baseline = Baseline.load(
+        os.path.join(REPO_ROOT, "tools", "lint", "baseline.json"))
+    assert len(baseline.entries) > 0
+    assert {v.key for v in report.suppressed} == set(baseline.entries)
+    for key, justification in baseline.entries.items():
+        assert justification.strip(), f"empty justification for {key}"
+        assert not justification.startswith("UNJUSTIFIED"), key
+
+
+# -- rule discovery ------------------------------------------------------------------
+
+
+def test_discovery_finds_all_rules():
+    installed = RuleDiscovery().installed_rules
+    assert tuple(installed) == ALL_RULES
+    for code, cls in installed.items():
+        assert issubclass(cls, LintRule)
+        assert cls.code == code
+        assert cls.name and cls.description
+
+
+def test_discovery_build_and_subset():
+    discovery = RuleDiscovery()
+    assert isinstance(discovery.build_rule("R3"),
+                      trace_safety.TraceSafetyRule)
+    subset = discovery.get_rules(["R5", "R1"])
+    assert [rule.code for rule in subset] == ["R5", "R1"]
+    with pytest.raises(KeyError):
+        discovery.get_rules(["R9"])
+
+
+def test_discovery_is_singleton():
+    assert RuleDiscovery() is RuleDiscovery()
+
+
+# -- fixtures: every rule fires on its bad inputs, not on its clean ones -------------
+
+
+def _r1(name):
+    return silent_excepts.check_file(name, _fixture_tree(name))
+
+
+def _r2(name):
+    return dispatch_bypass.check_file(name, _fixture_tree(name))
+
+
+def _r3(name):
+    return trace_safety.analyze_modules([(name, _fixture_tree(name))])
+
+
+def _r4(name):
+    return opcode_semantics.check_interpreter_file(
+        name, _fixture_tree(name), opcode_semantics.load_opcode_table())
+
+
+def _r5(name):
+    return env_knobs.check_file(name, _fixture_tree(name),
+                                env_knobs.load_registry())
+
+
+@pytest.mark.parametrize("runner,fixture,expected_sites", [
+    (_r1, "r1_bad_silent_pass.py", {"drain"}),
+    (_r1, "r1_bad_bare_continue.py", {"poll", "<module>"}),
+    (_r2, "r2_bad_direct_call.py", {"solve_cnf_device"}),
+    (_r2, "r2_bad_attr_call.py", {"solve_cnf_device_batch"}),
+    (_r3, "r3_bad_sync_in_jit.py", {"worst_lane", "_normalize"}),
+    (_r3, "r3_bad_branch_and_host.py", {"step", "drive"}),
+    (_r4, "r4_bad_unknown_refs.py", {"BOGUSADD", "NOTANOP"}),
+    (_r4, "r4_bad_for_loop.py", {"MYSTERYOP"}),
+    (_r5, "r5_bad_undeclared.py",
+     {"MYTHRIL_TPU_TURBO", "MYTHRIL_TPU_SPEED"}),
+    (_r5, "r5_bad_getenv.py",
+     {"MYTHRIL_TPU_MISSPELLED", "MYTHRIL_TPU_NOT_A_KNOB"}),
+])
+def test_bad_fixture_fires(runner, fixture, expected_sites):
+    violations = runner(fixture)
+    assert {v.where for v in violations} == expected_sites
+    for v in violations:
+        assert v.key.startswith(f"{v.rule}:")
+        assert v.lineno > 0
+
+
+@pytest.mark.parametrize("runner,fixture", [
+    (_r1, "r1_clean.py"),
+    (_r2, "r2_clean.py"),
+    (_r3, "r3_clean.py"),
+    (_r4, "r4_clean.py"),
+    (_r5, "r5_clean.py"),
+])
+def test_clean_fixture_is_quiet(runner, fixture):
+    assert runner(fixture) == []
+
+
+def test_r3_branch_sites_are_distinguished():
+    """The two R3 failure modes carry distinct site tags: trace-time
+    branching vs host-scope scalar pulls."""
+    keys = {v.key for v in _r3("r3_bad_branch_and_host.py")}
+    assert "R3:r3_bad_branch_and_host.py:step:branch-if" in keys
+    assert "R3:r3_bad_branch_and_host.py:drive:int-of-device" in keys
+    assert "R3:r3_bad_branch_and_host.py:drive:device_get" in keys
+
+
+def test_r4_table_is_byte_complete_in_tree():
+    """The acceptance property behind R4: every byte in ops/opcodes.py is
+    either dispatched by the interpreters or declared unimplemented —
+    proven by the rule producing no R4:dispatch:* violations on the
+    tree."""
+    violations = RuleDiscovery().build_rule("R4").run(LintContext())
+    assert [v for v in violations
+            if v.key.startswith("R4:dispatch:")] == []
+    assert [v for v in violations
+            if v.key.startswith(("R4:handler", "R4:pops", "R4:pushes"))] \
+        == []
+
+
+# -- migrated from the original tools/check_excepts.py tests -------------------------
+# (tests/test_lint_excepts.py keeps guarding the shim surface; these are the
+# same behavioral cases expressed against the framework rules.)
+
+
+def test_r1_detects_violation_with_lineno():
+    tree = _tree("def f():\n"
+                 "    try:\n"
+                 "        g()\n"
+                 "    except Exception:\n"
+                 "        pass\n")
+    violations = silent_excepts.check_file("bad.py", tree)
+    assert len(violations) == 1
+    assert violations[0].lineno == 4
+    assert violations[0].where == "f"
+
+
+@pytest.mark.parametrize("body", [
+    # narrow type: allowed
+    "def f():\n    try:\n        g()\n    except KeyError:\n        pass\n",
+    # broad but loud (logs + re-dispatches): allowed
+    "def f():\n    try:\n        g()\n    except Exception as e:\n"
+    "        log.warning('x %r', e)\n",
+])
+def test_r1_ignores_acceptable_handlers(body):
+    assert silent_excepts.check_file("ok.py", _tree(body)) == []
+
+
+@pytest.mark.parametrize("call", [
+    "jax_solver.solve_cnf_device(clauses, n_vars)",
+    "solve_cnf_device(clauses, n_vars)",
+    "jax_solver.solve_cnf_device_batch(queries)",
+])
+def test_r2_detects_bypass_forms(call):
+    tree = _tree(f"def f(clauses, n_vars, queries):\n    return {call}\n")
+    violations = dispatch_bypass.check_file("bad.py", tree)
+    assert len(violations) == 1
+    assert "dispatch" in violations[0].detail
+    assert "bypasses" in violations[0].detail
+
+
+def test_r2_allows_references_and_owning_files():
+    tree = _tree("from mythril_tpu.parallel.jax_solver import "
+                 "solve_cnf_device\nfn = solve_cnf_device\n")
+    assert dispatch_bypass.check_file("ok.py", tree) == []
+    ctx = LintContext()
+    for relpath in dispatch_bypass.DEVICE_CALLERS:
+        path = os.path.join(REPO_ROOT, relpath)
+        assert os.path.exists(path), f"stale DEVICE_CALLERS entry {relpath}"
+        assert dispatch_bypass.check_file(relpath, ctx.tree(path)) == []
+
+
+# -- baseline mechanics --------------------------------------------------------------
+
+
+def test_violation_default_key_is_line_number_free():
+    v = Violation("R1", "a.py", 17, "detail", where="f")
+    assert v.key == "R1:a.py:f"
+    assert Violation("R1", "a.py", 99, "detail", where="f").key == v.key
+    assert Violation("R2", "a.py", 3, "detail").key == "R2:a.py:<module>"
+    assert v.as_tuple() == ("a.py", 17, "detail")
+    assert v.as_dict()["key"] == "R1:a.py:f"
+
+
+def test_baseline_roundtrip(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    assert Baseline.load(path).entries == {}  # missing file -> empty
+    baseline = Baseline({"R1:a.py:f": "because"}, path)
+    baseline.save()
+    loaded = Baseline.load(path)
+    assert loaded.entries == {"R1:a.py:f": "because"}
+    data = json.load(open(path))
+    assert data["entries"] == [
+        {"key": "R1:a.py:f", "justification": "because"}]
+
+
+def test_baseline_update_from():
+    baseline = Baseline({"R1:a.py:f": "kept", "R1:gone.py:g": "stale"})
+    baseline.update_from([Violation("R1", "a.py", 1, "d", where="f"),
+                          Violation("R5", "b.py", 2, "d", where="K")])
+    assert baseline.entries == {
+        "R1:a.py:f": "kept",                 # live key keeps justification
+        "R5:b.py:K": Baseline.UNJUSTIFIED,   # new key gets placeholder
+    }                                        # stale key dropped
+
+
+def test_unjustified_baseline_entry_fails_lint(tmp_path):
+    """An entry added by --baseline-update still fails the lint until a
+    human replaces the placeholder."""
+    shipped = Baseline.load(
+        os.path.join(REPO_ROOT, "tools", "lint", "baseline.json"))
+    doctored = {key: (Baseline.UNJUSTIFIED
+                      if key.startswith("R1:") else justification)
+                for key, justification in shipped.entries.items()}
+    path = str(tmp_path / "baseline.json")
+    Baseline(doctored).save(path)
+    report = run_lint(baseline_path=path)
+    assert not report.ok
+    assert report.unjustified_keys == sorted(
+        key for key in shipped.entries if key.startswith("R1:"))
+    assert report.violations == []  # suppression itself still works
+
+
+def test_stale_baseline_entry_fails_lint(tmp_path):
+    shipped = Baseline.load(
+        os.path.join(REPO_ROOT, "tools", "lint", "baseline.json"))
+    doctored = dict(shipped.entries)
+    doctored["R1:mythril_tpu/parallel/nonexistent.py:ghost"] = "dead key"
+    path = str(tmp_path / "baseline.json")
+    Baseline(doctored).save(path)
+    report = run_lint(baseline_path=path)
+    assert not report.ok
+    assert report.stale_keys == [
+        "R1:mythril_tpu/parallel/nonexistent.py:ghost"]
+
+
+def test_baseline_hygiene_is_scoped_to_ran_rules(tmp_path):
+    """`--rule R5` must not flag R1's baseline entries as stale."""
+    shipped = Baseline.load(
+        os.path.join(REPO_ROOT, "tools", "lint", "baseline.json"))
+    path = str(tmp_path / "baseline.json")
+    Baseline(dict(shipped.entries)).save(path)
+    report = run_lint(codes=["R5"], baseline_path=path)
+    assert report.ok, (report.stale_keys, report.unjustified_keys,
+                       [v.key for v in report.violations])
+
+
+def test_empty_baseline_surfaces_audited_sites(tmp_path):
+    """With no baseline, the audited R1/R3 survivors become active
+    violations — the suppression is doing real work."""
+    path = str(tmp_path / "empty.json")
+    report = run_lint(baseline_path=path)
+    assert not report.ok
+    shipped = Baseline.load(
+        os.path.join(REPO_ROOT, "tools", "lint", "baseline.json"))
+    assert {v.key for v in report.violations} == set(shipped.entries)
+
+
+# -- CLI -----------------------------------------------------------------------------
+
+
+def test_cli_clean_on_tree():
+    proc = _run_cli(check=True)
+    assert "tpu-lint: clean" in proc.stdout
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules", check=True)
+    for code in ALL_RULES:
+        assert code in proc.stdout
+
+
+def test_cli_json_report():
+    proc = _run_cli("--json", check=True)
+    data = json.loads(proc.stdout)
+    assert data["ok"] is True
+    assert data["violations"] == []
+    assert data["stale_baseline_keys"] == []
+    assert len(data["suppressed"]) > 0
+
+
+def test_cli_exits_1_with_empty_baseline(tmp_path):
+    proc = _run_cli("--baseline", str(tmp_path / "empty.json"))
+    assert proc.returncode == 1
+    assert "violation(s)" in proc.stdout
+
+
+def test_cli_baseline_update_flow(tmp_path):
+    """--baseline-update writes UNJUSTIFIED placeholders that still fail
+    the lint — allowlist growth is an explicit two-step diff."""
+    path = str(tmp_path / "new.json")
+    proc = _run_cli("--baseline", path, "--baseline-update", check=True)
+    assert "baseline updated" in proc.stdout
+    written = Baseline.load(path)
+    shipped = Baseline.load(
+        os.path.join(REPO_ROOT, "tools", "lint", "baseline.json"))
+    assert set(written.entries) == set(shipped.entries)
+    assert all(j == Baseline.UNJUSTIFIED
+               for j in written.entries.values())
+    proc = _run_cli("--baseline", path)
+    assert proc.returncode == 1
+    assert "no justification" in proc.stdout
+
+
+@pytest.mark.parametrize("fixture", sorted(
+    name for name in os.listdir(FIXTURE_DIR)
+    if name.endswith(".py") and "_bad_" in name))
+def test_cli_exits_1_on_bad_fixture(fixture):
+    proc = _run_cli(os.path.join("tests", "data", "lint", fixture))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert fixture.split("_", 1)[0].upper() in proc.stdout
+
+
+@pytest.mark.parametrize("fixture", sorted(
+    name for name in os.listdir(FIXTURE_DIR)
+    if name.endswith(".py") and "clean" in name))
+def test_cli_exits_0_on_clean_fixture(fixture):
+    _run_cli(os.path.join("tests", "data", "lint", fixture), check=True)
+
+
+# -- tools/check_excepts.py back-compat shim -----------------------------------------
+
+
+def _load_shim():
+    tools_dir = os.path.join(REPO_ROOT, "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import check_excepts
+    return check_excepts
+
+
+def test_shim_clean_on_tree_and_subprocess_exit_0():
+    shim = _load_shim()
+    assert shim.run() == []
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "check_excepts.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_shim_exits_1_on_violations(monkeypatch, capsys):
+    """Pointed at the bad fixtures, the shim's main() returns exit
+    status 1 and prints the legacy relpath:lineno lines."""
+    shim = _load_shim()
+    monkeypatch.setattr(shim, "SCAN_DIRS", ("tests/data/lint",))
+    monkeypatch.setattr(shim, "DEVICE_SCAN_DIR", "tests/data/lint")
+    assert shim.main() == 1
+    out = capsys.readouterr().out
+    assert "violation(s) found" in out
+    assert "r1_bad_silent_pass.py:8" in out
+    assert "r2_bad_direct_call.py:7" in out
+
+
+def test_shim_allowlist_matches_baseline():
+    """The shim's frozen ALLOWLIST and the framework baseline's R1
+    entries must stay in sync — they describe the same audited sites."""
+    shim = _load_shim()
+    shipped = Baseline.load(
+        os.path.join(REPO_ROOT, "tools", "lint", "baseline.json"))
+    r1_keys = {key for key in shipped.entries if key.startswith("R1:")}
+    shim_keys = {f"R1:{path}:{fn}" for path, fn in shim.ALLOWLIST}
+    assert shim_keys == r1_keys

@@ -1,0 +1,1 @@
+"""Repo tooling package (lint framework, bench/measure scripts)."""

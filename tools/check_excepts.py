@@ -1,45 +1,41 @@
 #!/usr/bin/env python
-"""Lint: no new silent blanket exception swallows in the solver/device stack,
-and no device-solver calls that bypass the batched dispatch layer.
+"""Back-compat shim over tpu-lint rules R1 (silent excepts) and R2
+(dispatch bypass).
 
-Rule 1 — silent swallows: scans `mythril_tpu/smt/` and `mythril_tpu/parallel/`
-for `except` handlers that are BOTH broad (bare `except:`,
-`except Exception:`, or `except BaseException:`) AND silent (a body of only
-`pass`/`continue`/`...`). A handler like that erases the entire failure story
-the resilience subsystem exists to tell (support/resilience.py: every backend
-failure must be classified, logged, and counted) — it is exactly the pattern
-ISSUE 2 replaced at smt/solver/solver.py:48.
-
-Audited survivors live in ALLOWLIST, keyed (file, enclosing def): sites
-where swallowing is the correct behavior (e.g. a __del__ finalizer, where
-raising during interpreter teardown is worse than any leak). Add a new
-entry only with a comment defending it.
-
-Rule 2 — dispatch bypass: scans all of `mythril_tpu/` for calls to
-`solve_cnf_device` / `solve_cnf_device_batch` outside
-smt/solver/dispatch.py (the batching queue that owns the resilience
-contract: one breaker fire per batch, verdict caching, crosscheck sampling)
-and parallel/jax_solver.py (the implementation itself). A direct call skips
-the circuit breaker, the verdict cache, and the batch statistics — every
-caller must go through `dispatch.submit()`/`dispatch.solve()`.
-
-Run directly (`python tools/check_excepts.py`) or via the tier-1 suite
-(tests/test_lint_excepts.py). Exit status 1 on violations.
+The two original ad-hoc rules now live in the rule-plugin framework under
+``tools/lint/`` (see README "Static analysis"); this module keeps the
+historical surface — ``check_file()``, ``check_device_calls()``,
+``run()``, ``ALLOWLIST``, the ``(relpath, lineno, detail)`` violation
+tuples, and exit status 1 from ``python tools/check_excepts.py`` — so
+existing wiring (tests/test_lint_excepts.py, CI one-liners) keeps
+working. New rules and new allowlist entries belong in ``tools/lint/``,
+not here.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import List, Optional, Tuple
 
+if __package__ in (None, ""):  # run as a script / imported from tools/ dir
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.lint import LintContext
+    from tools.lint.rules import dispatch_bypass as _r2
+    from tools.lint.rules import silent_excepts as _r1
+else:
+    from .lint import LintContext
+    from .lint.rules import dispatch_bypass as _r2
+    from .lint.rules import silent_excepts as _r1
+
 REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 #: directories whose every .py file is linted (repo-relative)
-SCAN_DIRS = ("mythril_tpu/smt", "mythril_tpu/parallel")
+SCAN_DIRS = _r1.SCAN_DIRS
 
-#: audited (repo-relative path, enclosing function name) pairs
+#: audited (repo-relative path, enclosing function name) pairs — kept in
+#: sync with the R1 entries in tools/lint/baseline.json
 ALLOWLIST = {
     # finalizer: raising inside __del__ during interpreter shutdown turns a
     # leak into a spurious stderr traceback; close() is the loud path
@@ -50,126 +46,54 @@ ALLOWLIST = {
 }
 
 #: device-solver entry points that must only be reached via the dispatch queue
-DEVICE_ENTRYPOINTS = ("solve_cnf_device", "solve_cnf_device_batch")
+DEVICE_ENTRYPOINTS = _r2.DEVICE_ENTRYPOINTS
 
 #: the only files allowed to call DEVICE_ENTRYPOINTS directly (repo-relative)
-DEVICE_CALLERS = {
-    "mythril_tpu/smt/solver/dispatch.py",
-    "mythril_tpu/parallel/jax_solver.py",
-}
+DEVICE_CALLERS = _r2.DEVICE_CALLERS
 
 #: rule-2 scan root: the whole package, not just SCAN_DIRS
-DEVICE_SCAN_DIR = "mythril_tpu"
+DEVICE_SCAN_DIR = _r2.SCAN_DIR
 
-_BROAD = ("Exception", "BaseException")
-
-
-def _is_broad(handler: ast.ExceptHandler) -> bool:
-    node = handler.type
-    if node is None:
-        return True
-    if isinstance(node, ast.Name):
-        return node.id in _BROAD
-    if isinstance(node, ast.Tuple):
-        return any(isinstance(e, ast.Name) and e.id in _BROAD
-                   for e in node.elts)
-    return False
+_is_broad = _r1.is_broad
+_is_silent = _r1.is_silent
+_enclosing_function = _r1.enclosing_function
 
 
-def _is_silent(handler: ast.ExceptHandler) -> bool:
-    return all(isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue)
-               or (isinstance(stmt, ast.Expr)
-                   and isinstance(stmt.value, ast.Constant)
-                   and stmt.value.value is Ellipsis)
-               for stmt in handler.body)
+def _ctx() -> LintContext:
+    return LintContext(REPO_ROOT)
 
 
-def _enclosing_function(tree: ast.AST, target: ast.ExceptHandler
-                        ) -> Optional[str]:
-    """Name of the innermost def/async def containing `target` (module
-    level -> None)."""
-    found: List[Optional[str]] = [None]
+def _parse(path: str):
+    import ast
 
-    def descend(node: ast.AST, current: Optional[str]) -> None:
-        for child in ast.iter_child_nodes(node):
-            if child is target:
-                found[0] = current
-                return
-            name = current
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                name = child.name
-            descend(child, name)
-
-    descend(tree, None)
-    return found[0]
+    with open(path, "r", encoding="utf-8") as handle:
+        return ast.parse(handle.read(), filename=path)
 
 
 def check_file(path: str) -> List[Tuple[str, int, str]]:
-    """Returns violations as (relpath, lineno, detail)."""
+    """Rule 1 violations as (relpath, lineno, detail), ALLOWLIST applied."""
     relpath = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
-    with open(path, "r", encoding="utf-8") as handle:
-        tree = ast.parse(handle.read(), filename=path)
-    violations = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if not (_is_broad(node) and _is_silent(node)):
-            continue
-        function = _enclosing_function(tree, node)
-        if (relpath, function) in ALLOWLIST:
-            continue
-        where = function or "<module>"
-        violations.append((
-            relpath, node.lineno,
-            f"silent blanket except in {where}() — classify and log the "
-            "failure (support/resilience.py) or narrow the except; "
-            "allowlist in tools/check_excepts.py only with justification"))
-    return violations
+    violations = _r1.check_file(relpath, _parse(path))
+    return [v.as_tuple() for v in violations
+            if (v.path, None if v.where == "<module>" else v.where)
+            not in ALLOWLIST]
 
 
 def check_device_calls(path: str) -> List[Tuple[str, int, str]]:
     """Rule 2: direct `solve_cnf_device[_batch](...)` calls outside the
     dispatch layer. Returns violations as (relpath, lineno, detail)."""
     relpath = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
-    if relpath in DEVICE_CALLERS:
-        return []
-    with open(path, "r", encoding="utf-8") as handle:
-        tree = ast.parse(handle.read(), filename=path)
-    violations = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        name = None
-        if isinstance(func, ast.Name):
-            name = func.id
-        elif isinstance(func, ast.Attribute):
-            name = func.attr
-        if name not in DEVICE_ENTRYPOINTS:
-            continue
-        violations.append((
-            relpath, node.lineno,
-            f"direct {name}() call bypasses the batched dispatch layer "
-            "(breaker, verdict cache, crosscheck sampling) — go through "
-            "smt/solver/dispatch.submit()/solve() instead"))
-    return violations
+    return [v.as_tuple()
+            for v in _r2.check_file(relpath, _parse(path))]
 
 
 def run() -> List[Tuple[str, int, str]]:
+    ctx = _ctx()
     violations = []
-    for scan_dir in SCAN_DIRS:
-        base = os.path.join(REPO_ROOT, scan_dir)
-        for dirpath, _, filenames in os.walk(base):
-            for filename in sorted(filenames):
-                if filename.endswith(".py"):
-                    violations.extend(
-                        check_file(os.path.join(dirpath, filename)))
-    base = os.path.join(REPO_ROOT, DEVICE_SCAN_DIR)
-    for dirpath, _, filenames in os.walk(base):
-        for filename in sorted(filenames):
-            if filename.endswith(".py"):
-                violations.extend(
-                    check_device_calls(os.path.join(dirpath, filename)))
+    for path in ctx.iter_py(*SCAN_DIRS):
+        violations.extend(check_file(path))
+    for path in ctx.iter_py(DEVICE_SCAN_DIR):
+        violations.extend(check_device_calls(path))
     return violations
 
 
@@ -178,7 +102,7 @@ def main() -> int:
     for relpath, lineno, detail in violations:
         print(f"{relpath}:{lineno}: {detail}")
     if violations:
-        print(f"\n{len(violations)} silent blanket except(s) found")
+        print(f"\n{len(violations)} violation(s) found")
         return 1
     return 0
 

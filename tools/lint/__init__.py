@@ -1,0 +1,310 @@
+"""tpu-lint: the repo's rule-plugin static-analysis framework.
+
+The north star is a lockstep-vmapped symbolic EVM whose host (LASER-style)
+and device (lockstep) paths must never diverge semantically, and whose hot
+paths must never silently fall off the device. The invariants that keep
+that true live here as pluggable AST rules — the same shape as the
+detector modules under ``mythril_tpu/plugin/`` (a discovery singleton over
+a rules package), applied to the source tree instead of the state space.
+
+Rules (see ``tools/lint/rules/``):
+
+* **R1 silent-excepts** — no silent blanket ``except`` swallows in the
+  solver/device stack.
+* **R2 dispatch-bypass** — no direct device-solver calls around the
+  batched dispatch layer.
+* **R3 trace-safety** — no implicit host↔device syncs or Python-side
+  branching on traced values inside jit/vmap hot paths, and every
+  *explicit* host sync site in ``mythril_tpu/parallel/`` must carry a
+  baseline justification proving it is a deliberate bulk transfer.
+* **R4 opcode-semantics** — the ``ops/opcodes.py`` table, the lockstep and
+  symstep interpreters, and the host instruction handlers must agree:
+  byte-complete dispatch parity and stack-effect consistency.
+* **R5 env-knobs** — every ``MYTHRIL_TPU_*`` env read must be declared in
+  the ``mythril_tpu/support/tpu_config.py`` registry, and the README knob
+  table must match the registry rendering.
+
+Run ``python -m tools.lint`` (exit 1 on violations), or via the tier-1
+suite (tests/test_lint.py). Known, audited violations live in
+``tools/lint/baseline.json`` keyed by a stable fingerprint; every entry
+carries a justification, stale entries fail the lint, and
+``--baseline-update`` makes intentional growth an explicit diff.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import json
+import os
+import pkgutil
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(__file__), "baseline.json")
+
+
+class Violation:
+    """One finding: (rule code, repo-relative path, line, detail).
+
+    ``where`` names the enclosing context (function name or a site tag);
+    ``key`` is the stable baseline fingerprint — deliberately line-number
+    free so unrelated edits above a site don't invalidate its entry.
+    """
+
+    __slots__ = ("rule", "path", "lineno", "where", "detail", "key")
+
+    def __init__(self, rule: str, path: str, lineno: int, detail: str,
+                 where: Optional[str] = None, key: Optional[str] = None):
+        self.rule = rule
+        self.path = path
+        self.lineno = lineno
+        self.where = where or "<module>"
+        self.detail = detail
+        self.key = key or f"{rule}:{path}:{self.where}"
+
+    def as_tuple(self) -> Tuple[str, int, str]:
+        """Legacy (relpath, lineno, detail) shape (check_excepts API)."""
+        return (self.path, self.lineno, self.detail)
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "lineno": self.lineno,
+                "where": self.where, "detail": self.detail, "key": self.key}
+
+    def __repr__(self) -> str:
+        return f"Violation({self.rule} {self.path}:{self.lineno} {self.detail!r})"
+
+
+class LintContext:
+    """Shared parse cache + tree-walking helpers handed to every rule."""
+
+    def __init__(self, repo_root: str = REPO_ROOT):
+        self.repo_root = repo_root
+        self._trees: Dict[str, ast.AST] = {}
+        self._sources: Dict[str, str] = {}
+
+    def relpath(self, path: str) -> str:
+        return os.path.relpath(path, self.repo_root).replace(os.sep, "/")
+
+    def source(self, path: str) -> str:
+        relpath = self.relpath(path)
+        if relpath not in self._sources:
+            with open(os.path.join(self.repo_root, relpath),
+                      encoding="utf-8") as handle:
+                self._sources[relpath] = handle.read()
+        return self._sources[relpath]
+
+    def tree(self, path: str) -> ast.AST:
+        relpath = self.relpath(path)
+        if relpath not in self._trees:
+            self._trees[relpath] = ast.parse(
+                self.source(relpath), filename=relpath)
+        return self._trees[relpath]
+
+    def iter_py(self, *scan_dirs: str) -> Iterator[str]:
+        """Absolute paths of every .py file under the repo-relative dirs."""
+        for scan_dir in scan_dirs:
+            base = os.path.join(self.repo_root, scan_dir)
+            if os.path.isfile(base) and base.endswith(".py"):
+                yield base
+                continue
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d != "__pycache__"]
+                for filename in sorted(filenames):
+                    if filename.endswith(".py"):
+                        yield os.path.join(dirpath, filename)
+
+
+class LintRule:
+    """Base class for lint rules — mirrors plugin/interface.MythrilPlugin:
+    subclasses carry their metadata as class attributes and are picked up
+    by RuleDiscovery from the ``tools.lint.rules`` package."""
+
+    code: str = "R?"                #: short id used by --rule and baselines
+    name: str = "unnamed-rule"      #: kebab-case rule name
+    description: str = ""           #: one-liner for --list-rules
+    default_enabled: bool = True
+
+    def run(self, ctx: LintContext) -> List[Violation]:
+        raise NotImplementedError
+
+    def check_paths(self, ctx: LintContext,
+                    paths: Sequence[str]) -> List[Violation]:
+        """File-scoped variant of run() over explicit paths (fixtures,
+        pre-commit hooks). Rules whose checks are repo-structural rather
+        than per-file (e.g. R4's dispatch-coverage direction) contribute
+        only their per-file direction here."""
+        return []
+
+
+class RuleDiscovery:
+    """Singleton that discovers LintRule subclasses in ``tools.lint.rules``
+    (same shape as plugin/discovery.PluginDiscovery, with the package
+    itself standing in for the entry-point group)."""
+
+    _instance: Optional["RuleDiscovery"] = None
+
+    def __new__(cls) -> "RuleDiscovery":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._rules = None
+        return cls._instance
+
+    def _discover(self) -> Dict[str, type]:
+        from . import rules as rules_pkg
+
+        found: Dict[str, type] = {}
+        for info in sorted(pkgutil.iter_modules(rules_pkg.__path__),
+                           key=lambda info: info.name):
+            module = importlib.import_module(
+                f"{rules_pkg.__name__}.{info.name}")
+            for obj in vars(module).values():
+                if (isinstance(obj, type) and issubclass(obj, LintRule)
+                        and obj is not LintRule
+                        and obj.__module__ == module.__name__):
+                    found[obj.code] = obj
+        return dict(sorted(found.items()))
+
+    @property
+    def installed_rules(self) -> Dict[str, type]:
+        if self._rules is None:
+            self._rules = self._discover()
+        return self._rules
+
+    def build_rule(self, code: str) -> LintRule:
+        return self.installed_rules[code]()
+
+    def get_rules(self, codes: Optional[Sequence[str]] = None
+                  ) -> List[LintRule]:
+        installed = self.installed_rules
+        if codes is None:
+            return [cls() for cls in installed.values()
+                    if cls.default_enabled]
+        unknown = [code for code in codes if code not in installed]
+        if unknown:
+            raise KeyError(
+                f"unknown rule(s) {unknown}; installed: "
+                f"{sorted(installed)}")
+        return [installed[code]() for code in codes]
+
+
+# -- baseline ------------------------------------------------------------------------
+
+class Baseline:
+    """Audited-violation allowlist: {key: justification}. Every entry MUST
+    carry a non-empty justification (an entry created by --baseline-update
+    starts as UNJUSTIFIED and fails the lint until a human writes one),
+    and entries that no longer match a live violation fail as stale — a
+    dead key would let a future regression sneak in under it."""
+
+    UNJUSTIFIED = "UNJUSTIFIED: new entry — write a real justification"
+
+    def __init__(self, entries: Optional[Dict[str, str]] = None,
+                 path: Optional[str] = None):
+        self.entries = dict(entries or {})
+        self.path = path
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls({}, path)
+        with open(path, encoding="utf-8") as handle:
+            data = json.load(handle)
+        entries = {entry["key"]: entry.get("justification", "")
+                   for entry in data.get("entries", [])}
+        return cls(entries, path)
+
+    def save(self, path: Optional[str] = None) -> None:
+        target = path or self.path
+        data = {
+            "_comment": (
+                "tpu-lint baseline: audited violations keyed by stable "
+                "fingerprint. Add entries only via "
+                "`python -m tools.lint --baseline-update`, then replace "
+                "the UNJUSTIFIED placeholder with a real defense."),
+            "entries": [
+                {"key": key, "justification": justification}
+                for key, justification in sorted(self.entries.items())
+            ],
+        }
+        with open(target, "w", encoding="utf-8") as handle:
+            json.dump(data, handle, indent=2)
+            handle.write("\n")
+
+    def update_from(self, violations: Sequence[Violation]) -> None:
+        """--baseline-update: keep justifications for keys that still fire,
+        drop stale keys, add UNJUSTIFIED placeholders for new ones."""
+        live = {v.key for v in violations}
+        self.entries = {
+            key: self.entries.get(key, self.UNJUSTIFIED) for key in live}
+
+
+class LintReport:
+    """Outcome of a lint run: active violations plus baseline hygiene
+    failures (stale or unjustified entries)."""
+
+    def __init__(self, violations: List[Violation],
+                 suppressed: List[Violation],
+                 stale_keys: List[str], unjustified_keys: List[str]):
+        self.violations = violations
+        self.suppressed = suppressed
+        self.stale_keys = stale_keys
+        self.unjustified_keys = unjustified_keys
+
+    @property
+    def ok(self) -> bool:
+        return not (self.violations or self.stale_keys
+                    or self.unjustified_keys)
+
+
+def run_rules(rules: Sequence[LintRule],
+              ctx: Optional[LintContext] = None) -> List[Violation]:
+    ctx = ctx or LintContext()
+    violations: List[Violation] = []
+    for rule in rules:
+        violations.extend(rule.run(ctx))
+    return violations
+
+
+def run_lint(codes: Optional[Sequence[str]] = None,
+             baseline_path: str = DEFAULT_BASELINE,
+             ctx: Optional[LintContext] = None,
+             paths: Optional[Sequence[str]] = None) -> LintReport:
+    """Run the selected rules and fold in the baseline. This is the
+    programmatic entry point the CLI and the tier-1 test share. With
+    ``paths``, each rule's file-scoped checker runs over just those files
+    (and baseline hygiene is skipped — a partial view can't judge
+    staleness)."""
+    rules = RuleDiscovery().get_rules(codes)
+    ctx = ctx or LintContext()
+    if paths is None:
+        raw = run_rules(rules, ctx)
+    else:
+        raw = []
+        for rule in rules:
+            raw.extend(rule.check_paths(ctx, paths))
+    baseline = Baseline.load(baseline_path)
+    ran_codes = {rule.code for rule in rules} if paths is None else set()
+
+    active, suppressed = [], []
+    hit_keys = set()
+    for violation in raw:
+        if violation.key in baseline.entries:
+            hit_keys.add(violation.key)
+            suppressed.append(violation)
+        else:
+            active.append(violation)
+    # baseline hygiene only for the rules that actually ran: a --rule R3
+    # run must not flag R1's entries as stale
+    scoped = {key for key in baseline.entries
+              if key.split(":", 1)[0] in ran_codes}
+    stale = sorted(scoped - hit_keys)
+    unjustified = sorted(
+        key for key in scoped & hit_keys
+        if not baseline.entries[key].strip()
+        or baseline.entries[key].startswith("UNJUSTIFIED"))
+    return LintReport(active, suppressed, stale, unjustified)

@@ -1,0 +1,106 @@
+"""CLI for tpu-lint: ``python -m tools.lint``.
+
+Exit status 1 on any active violation, stale baseline entry, or
+unjustified baseline entry; 0 on a clean tree.
+
+    python -m tools.lint                      # run every rule
+    python -m tools.lint --list-rules         # rule inventory
+    python -m tools.lint --rule R3 --rule R5  # subset
+    python -m tools.lint --json               # machine-readable findings
+    python -m tools.lint PATH [PATH ...]      # file-scoped run: each
+        rule's per-file checker over just those files (fixtures,
+        pre-commit); baseline hygiene is skipped on partial views
+    python -m tools.lint --baseline-update    # refresh baseline.json:
+        keeps justifications for keys that still fire, drops stale keys,
+        adds UNJUSTIFIED placeholders (which still fail the lint) for new
+        ones — intentional allowlist growth is always an explicit diff.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (DEFAULT_BASELINE, Baseline, RuleDiscovery, run_lint,
+               run_rules)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="tpu-lint: repo-specific static-analysis rules")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="list installed rules and exit")
+    parser.add_argument("--rule", action="append", metavar="CODE",
+                        help="run only this rule (repeatable), e.g. R3")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as JSON")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        metavar="PATH", help="baseline file "
+                        "(default tools/lint/baseline.json)")
+    parser.add_argument("--baseline-update", action="store_true",
+                        help="rewrite the baseline from current findings")
+    parser.add_argument("paths", nargs="*", metavar="PATH",
+                        help="lint only these files (file-scoped rule "
+                        "checkers; default: the whole tree)")
+    args = parser.parse_args(argv)
+
+    discovery = RuleDiscovery()
+    if args.list_rules:
+        for code, cls in discovery.installed_rules.items():
+            print(f"{code}  {cls.name:<18} {cls.description}")
+        return 0
+
+    if args.baseline_update:
+        rules = discovery.get_rules(args.rule)
+        raw = run_rules(rules)
+        baseline = Baseline.load(args.baseline)
+        before = set(baseline.entries)
+        baseline.update_from(raw)
+        baseline.save(args.baseline)
+        added = sorted(set(baseline.entries) - before)
+        dropped = sorted(before - set(baseline.entries))
+        print(f"baseline updated: {len(baseline.entries)} entries "
+              f"({len(added)} added, {len(dropped)} dropped)")
+        for key in added:
+            print(f"  + {key}  (UNJUSTIFIED — write a justification)")
+        for key in dropped:
+            print(f"  - {key}")
+        return 0
+
+    paths = [os.path.abspath(p) for p in args.paths] or None
+    report = run_lint(args.rule, baseline_path=args.baseline, paths=paths)
+    if args.as_json:
+        print(json.dumps({
+            "violations": [v.as_dict() for v in report.violations],
+            "suppressed": [v.as_dict() for v in report.suppressed],
+            "stale_baseline_keys": report.stale_keys,
+            "unjustified_baseline_keys": report.unjustified_keys,
+            "ok": report.ok,
+        }, indent=2))
+        return 0 if report.ok else 1
+
+    for violation in report.violations:
+        print(f"{violation.path}:{violation.lineno}: [{violation.rule}] "
+              f"{violation.detail}")
+    for key in report.stale_keys:
+        print(f"baseline: stale entry {key} — the site is gone; remove "
+              "the entry (python -m tools.lint --baseline-update)")
+    for key in report.unjustified_keys:
+        print(f"baseline: entry {key} has no justification — defend it "
+              "in tools/lint/baseline.json or fix the violation")
+    if not report.ok:
+        print(f"\n{len(report.violations)} violation(s), "
+              f"{len(report.stale_keys)} stale and "
+              f"{len(report.unjustified_keys)} unjustified baseline "
+              "entr(ies)")
+        return 1
+    print(f"tpu-lint: clean ({len(report.suppressed)} baselined "
+          "finding(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+"""tpu-lint rule modules. Every module here that defines a LintRule
+subclass is auto-discovered by tools.lint.RuleDiscovery — add a rule by
+dropping a new module in this package (see README "Static analysis")."""

@@ -1,0 +1,74 @@
+"""R2 — no device-solver calls that bypass the batched dispatch layer.
+
+Scans all of `mythril_tpu/` for calls to `solve_cnf_device` /
+`solve_cnf_device_batch` outside smt/solver/dispatch.py (the batching
+queue that owns the resilience contract: one breaker fire per batch,
+verdict caching, crosscheck sampling) and parallel/jax_solver.py (the
+implementation itself). A direct call skips the circuit breaker, the
+verdict cache, and the batch statistics — every caller must go through
+`dispatch.submit()`/`dispatch.solve()`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import LintContext, LintRule, Violation
+
+#: device-solver entry points that must only be reached via the dispatch queue
+DEVICE_ENTRYPOINTS = ("solve_cnf_device", "solve_cnf_device_batch")
+
+#: the only files allowed to call DEVICE_ENTRYPOINTS directly (repo-relative)
+DEVICE_CALLERS = {
+    "mythril_tpu/smt/solver/dispatch.py",
+    "mythril_tpu/parallel/jax_solver.py",
+}
+
+#: scan root: the whole package
+SCAN_DIR = "mythril_tpu"
+
+
+def check_file(relpath: str, tree: ast.AST) -> List[Violation]:
+    """Direct `solve_cnf_device[_batch](...)` calls in one parsed file.
+    References that are not calls (imports, monkeypatch targets) pass."""
+    if relpath in DEVICE_CALLERS:
+        return []
+    violations = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        if name not in DEVICE_ENTRYPOINTS:
+            continue
+        violations.append(Violation(
+            "R2", relpath, node.lineno,
+            f"direct {name}() call bypasses the batched dispatch layer "
+            "(breaker, verdict cache, crosscheck sampling) — go through "
+            "smt/solver/dispatch.submit()/solve() instead",
+            where=name))
+    return violations
+
+
+class DispatchBypassRule(LintRule):
+    code = "R2"
+    name = "dispatch-bypass"
+    description = ("no direct solve_cnf_device[_batch]() calls outside "
+                   "smt/solver/dispatch.py and parallel/jax_solver.py")
+
+    def run(self, ctx: LintContext) -> List[Violation]:
+        violations = []
+        for path in ctx.iter_py(SCAN_DIR):
+            violations.extend(check_file(ctx.relpath(path), ctx.tree(path)))
+        return violations
+
+    def check_paths(self, ctx: LintContext, paths) -> List[Violation]:
+        violations = []
+        for path in paths:
+            violations.extend(check_file(ctx.relpath(path), ctx.tree(path)))
+        return violations

@@ -1,0 +1,161 @@
+"""R5 — env-knob hygiene: every ``MYTHRIL_TPU_*`` read is declared.
+
+An undeclared knob is invisible: it has no documented type or default, no
+README entry, and a typo in its name silently reads the default forever.
+This rule enforces the ``mythril_tpu/support/tpu_config.py`` registry as
+the single source of truth:
+
+* every ``os.environ.get/[]/pop/setdefault`` or ``os.getenv`` read of a
+  ``MYTHRIL_TPU_*`` name — anywhere in ``mythril_tpu/``, ``tools/``,
+  ``tests/``, or ``bench.py`` — must name a registered knob (writes via
+  ``setdefault``/``[...] =`` are checked too: setting an undeclared knob
+  is the same typo one step earlier);
+* the README knob table between the ``<!-- knob-table:start -->`` /
+  ``<!-- knob-table:end -->`` markers must byte-match
+  ``tpu_config.render_markdown_table()`` — regenerate with
+  ``python -m mythril_tpu.support.tpu_config``.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import List, Set
+
+from .. import REPO_ROOT, LintContext, LintRule, Violation
+
+TPU_CONFIG_PATH = "mythril_tpu/support/tpu_config.py"
+SCAN_DIRS = ("mythril_tpu", "tools", "tests", "bench.py")
+README_PATH = "README.md"
+TABLE_START = "<!-- knob-table:start -->"
+TABLE_END = "<!-- knob-table:end -->"
+
+PREFIX = "MYTHRIL_TPU_"
+
+
+def load_registry() -> Set[str]:
+    """Declared knob names, loaded straight from tpu_config.py by file
+    path (stdlib-only module; never drags jax in)."""
+    path = os.path.join(REPO_ROOT, TPU_CONFIG_PATH)
+    spec = importlib.util.spec_from_file_location(
+        "_tpu_lint_tpu_config", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return set(module.REGISTRY)
+
+
+def _render_table() -> str:
+    path = os.path.join(REPO_ROOT, TPU_CONFIG_PATH)
+    spec = importlib.util.spec_from_file_location(
+        "_tpu_lint_tpu_config_render", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module.render_markdown_table()
+
+
+def _env_name(node: ast.AST) -> str:
+    """The MYTHRIL_TPU_* string literal named by an environ access node
+    argument, or ''."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith(PREFIX):
+        return node.value
+    return ""
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """`os.environ` / bare `environ`."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def check_file(relpath: str, tree: ast.AST,
+               registry: Set[str]) -> List[Violation]:
+    violations = []
+
+    def check_name(name: str, lineno: int, how: str) -> None:
+        if name and name not in registry:
+            violations.append(Violation(
+                "R5", relpath, lineno,
+                f"{how} of undeclared knob {name} — declare it in "
+                "mythril_tpu/support/tpu_config.py (name, type, default, "
+                "docstring) so the README table and the runtime accessors "
+                "know it exists",
+                where=name, key=f"R5:{relpath}:{name}"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and _is_environ(func.value) \
+                    and func.attr in ("get", "pop", "setdefault"):
+                if node.args:
+                    check_name(_env_name(node.args[0]), node.lineno,
+                               f"os.environ.{func.attr}")
+            elif isinstance(func, ast.Attribute) \
+                    and func.attr == "getenv":
+                if node.args:
+                    check_name(_env_name(node.args[0]), node.lineno,
+                               "os.getenv")
+        elif isinstance(node, ast.Subscript) \
+                and _is_environ(node.value):
+            sl = node.slice
+            if isinstance(sl, ast.Index):  # pragma: no cover (py<3.9)
+                sl = sl.value
+            check_name(_env_name(sl), node.lineno, "os.environ[...]")
+    return violations
+
+
+def check_readme_table(registry_render: str, readme_text: str
+                       ) -> List[Violation]:
+    start = readme_text.find(TABLE_START)
+    end = readme_text.find(TABLE_END)
+    if start < 0 or end < 0 or end < start:
+        return [Violation(
+            "R5", README_PATH, 1,
+            f"README is missing the {TABLE_START} / {TABLE_END} markers "
+            "around the env-knob table",
+            where="knob-table", key="R5:readme:markers")]
+    current = readme_text[start + len(TABLE_START):end].strip()
+    if current != registry_render.strip():
+        lineno = readme_text[:start].count("\n") + 1
+        return [Violation(
+            "R5", README_PATH, lineno,
+            "README knob table drifted from the tpu_config registry — "
+            "regenerate with `python -m mythril_tpu.support.tpu_config` "
+            "and paste between the markers",
+            where="knob-table", key="R5:readme:drift")]
+    return []
+
+
+class EnvKnobRule(LintRule):
+    code = "R5"
+    name = "env-knobs"
+    description = ("every MYTHRIL_TPU_* env read must be declared in "
+                   "support/tpu_config.py; README knob table must match "
+                   "the registry")
+
+    def run(self, ctx: LintContext) -> List[Violation]:
+        registry = load_registry()
+        violations: List[Violation] = []
+        for path in ctx.iter_py(*SCAN_DIRS):
+            relpath = ctx.relpath(path)
+            if relpath.startswith("tools/lint/") \
+                    or relpath == "tools/check_excepts.py" \
+                    or relpath.startswith("tests/data/lint/"):
+                continue  # the linter and its fixtures mention knobs freely
+            violations.extend(
+                check_file(relpath, ctx.tree(path), registry))
+        readme = os.path.join(ctx.repo_root, README_PATH)
+        if os.path.exists(readme):
+            violations.extend(
+                check_readme_table(_render_table(), ctx.source(readme)))
+        return violations
+
+    def check_paths(self, ctx: LintContext, paths) -> List[Violation]:
+        registry = load_registry()
+        violations: List[Violation] = []
+        for path in paths:
+            violations.extend(
+                check_file(ctx.relpath(path), ctx.tree(path), registry))
+        return violations

@@ -1,0 +1,330 @@
+"""R4 — opcode-semantics consistency between the table, the device
+interpreters, and the host handlers.
+
+The ``ops/opcodes.py`` table is the single source of truth: the lockstep
+interpreter densifies it into POPS/PUSHES/GAS/VALID arrays, and the host
+LASER engine dispatches ``core/instructions.py`` handlers by mnemonic.
+Those three views drift independently — a mnemonic typo in ``is_op("...")``
+compiles fine and silently never matches; a new table opcode with no
+dispatch silently escapes or errors; a handler whose stack effect differs
+from the table is host-vs-lockstep divergence the Z3 oracle only sees as
+an unexplained mismatch much later. This rule proves, statically:
+
+* **refs-exist**: every mnemonic the interpreters reference — via
+  ``is_op("NAME")`` / ``op_in(...)`` arguments, ``O["NAME"]`` subscripts,
+  or the string lists driving the table-densification ``for`` loops —
+  exists in the opcode table;
+* **byte-complete dispatch**: every byte in the table is either
+  referenced by mnemonic, covered by a decode byte-range
+  (``(op >= 0x5F) & (op <= 0x7F)`` / ``range(0x5F, 0xA0)``), or named in
+  lockstep's explicit ``UNIMPLEMENTED_OPS`` list;
+* **host parity**: every table mnemonic has a ``core/instructions.py``
+  handler (``add_``, generic ``push_``/``dup_``/``swap_``/``log_`` for
+  the generated families), and each handler's statically countable stack
+  effect (``mstate.pop(n)`` / ``stack.append``) matches the table's
+  POPS/PUSHES entry — data-dependent handlers are skipped explicitly in
+  ``STACK_CHECK_SKIP`` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from .. import REPO_ROOT, LintContext, LintRule, Violation
+
+OPCODES_PATH = "mythril_tpu/ops/opcodes.py"
+INTERPRETERS = ("mythril_tpu/parallel/lockstep.py",
+                "mythril_tpu/parallel/symstep.py")
+HANDLERS_PATH = "mythril_tpu/core/instructions.py"
+
+#: handlers whose stack effect is data-dependent or branch-duplicated in a
+#: way a static pop/append count cannot follow. Each entry defends itself;
+#: removing an entry is safe (the check simply starts running).
+STACK_CHECK_SKIP = {
+    # generic family handlers: the instruction byte decides n
+    "push_", "push0_", "dup_", "swap_", "log_",
+    # delegate to a shared call/create implementation; stack effect is
+    # applied inside the delegate across world-state forks
+    "call_", "callcode_", "delegatecall_", "staticcall_",
+    "create_", "create2_",
+    # halting/forking semantics: jumpi_ forks both sides structurally,
+    # return_/revert_/stop_/selfdestruct_ end the state instead of pushing
+    "jumpi_", "return_", "revert_", "stop_", "selfdestruct_", "invalid_",
+}
+
+_FAMILY = re.compile(r"^(PUSH|DUP|SWAP|LOG)(\d+)$")
+
+
+def load_opcode_table() -> Dict[str, Tuple[int, int, int]]:
+    """{mnemonic: (byte, pops, pushes)} loaded straight from
+    ops/opcodes.py by file path — the module is stdlib-only, so this
+    never drags jax in."""
+    path = os.path.join(REPO_ROOT, OPCODES_PATH)
+    spec = importlib.util.spec_from_file_location("_tpu_lint_opcodes", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return {
+        name: (meta[module.ADDRESS],
+               meta[module.STACK][0], meta[module.STACK][1])
+        for name, meta in module.OPCODES.items()
+    }
+
+
+# -- interpreter-side collection -------------------------------------------------
+
+
+def _const_str(node: ast.AST) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return ""
+
+
+def collect_mnemonic_refs(tree: ast.AST) -> Dict[str, int]:
+    """{mnemonic: first lineno} for every opcode-table reference: is_op/
+    op_in string arguments, O["..."] subscripts, and string constants in
+    the list/tuple literals that drive table-densification for-loops."""
+    refs: Dict[str, int] = {}
+
+    def add(name: str, lineno: int) -> None:
+        if name:
+            refs.setdefault(name, lineno)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            func = node.func
+            name = func.id if isinstance(func, ast.Name) else (
+                func.attr if isinstance(func, ast.Attribute) else None)
+            if name in ("is_op", "op_in"):
+                for arg in node.args:
+                    add(_const_str(arg), node.lineno)
+        elif isinstance(node, ast.Subscript):
+            if isinstance(node.value, ast.Name) and node.value.id == "O":
+                sl = node.slice
+                if isinstance(sl, ast.Index):  # pragma: no cover (py<3.9)
+                    sl = sl.value
+                add(_const_str(sl), node.lineno)
+        elif isinstance(node, ast.For) \
+                and isinstance(node.iter, (ast.List, ast.Tuple)):
+            for item in ast.walk(node.iter):
+                add(_const_str(item), node.lineno)
+    return refs
+
+
+def collect_byte_intervals(tree: ast.AST) -> List[Tuple[int, int]]:
+    """Inclusive [lo, hi] opcode-byte ranges the interpreters decode
+    wholesale: `(op >= 0x5F) & (op <= 0x7F)` masks and
+    `for _byte in range(0x5F, 0xA0)` densification loops. Only the
+    generated-family region (0x5F..0x9F) is accepted from range() loops,
+    so unrelated small loops can't fake dispatch coverage."""
+    intervals: List[Tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitAnd):
+            lo = _compare_bound(node.left, ("Gt", "GtE"))
+            hi = _compare_bound(node.right, ("Lt", "LtE"))
+            if lo is not None and hi is not None:
+                intervals.append((lo, hi))
+        elif isinstance(node, ast.For) and isinstance(node.iter, ast.Call):
+            func = node.iter.func
+            if isinstance(func, ast.Name) and func.id == "range" \
+                    and len(node.iter.args) == 2:
+                args = node.iter.args
+                if all(isinstance(a, ast.Constant)
+                       and isinstance(a.value, int) for a in args):
+                    lo, hi = args[0].value, args[1].value - 1
+                    if 0x5F <= lo <= hi <= 0x9F:
+                        intervals.append((lo, hi))
+    return intervals
+
+
+def _compare_bound(node: ast.AST, ops: Tuple[str, ...]):
+    """`op >= 0x5F` -> 0x5F (adjusted to inclusive), else None."""
+    if not (isinstance(node, ast.Compare) and len(node.ops) == 1
+            and isinstance(node.left, ast.Name) and node.left.id == "op"
+            and isinstance(node.comparators[0], ast.Constant)
+            and isinstance(node.comparators[0].value, int)):
+        return None
+    kind = type(node.ops[0]).__name__
+    value = node.comparators[0].value
+    if kind not in ops:
+        return None
+    if kind == "Gt":
+        value += 1
+    elif kind == "Lt":
+        value -= 1
+    return value
+
+
+def collect_unimplemented(tree: ast.AST) -> Set[str]:
+    """Mnemonics in an `UNIMPLEMENTED_OPS = [...]` module-level list —
+    the explicit "the device does not dispatch this" declaration."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if "UNIMPLEMENTED_OPS" in targets \
+                    and isinstance(node.value, (ast.List, ast.Tuple)):
+                for item in node.value.elts:
+                    if _const_str(item):
+                        names.add(_const_str(item))
+    return names
+
+
+def check_interpreter_file(relpath: str, tree: ast.AST,
+                           table: Dict[str, Tuple[int, int, int]]
+                           ) -> List[Violation]:
+    """refs-exist direction, per file (fixture-testable standalone)."""
+    violations = []
+    for name, lineno in sorted(collect_mnemonic_refs(tree).items()):
+        if name not in table:
+            violations.append(Violation(
+                "R4", relpath, lineno,
+                f"interpreter references unknown mnemonic {name!r} — not "
+                "in ops/opcodes.py, so the comparison can never match",
+                where=name, key=f"R4:{relpath}:ref:{name}"))
+    return violations
+
+
+# -- host-handler side -----------------------------------------------------------
+
+
+def handler_name_for(mnemonic: str) -> str:
+    family = _FAMILY.match(mnemonic)
+    if mnemonic == "PUSH0":
+        return "push0_"
+    if family:
+        return family.group(1).lower() + "_"
+    if mnemonic == "DIFFICULTY":  # pre-Merge alias for the same byte
+        return "prevrandao_"
+    return mnemonic.lower() + "_"
+
+
+def handler_stack_effect(fn: ast.AST) -> Tuple[int, int]:
+    """(pops, appends) statically counted from mstate.pop(n)/stack.pop()
+    and stack.append(...) calls."""
+    pops = appends = 0
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr == "pop":
+            owner = node.func.value
+            if isinstance(owner, ast.Attribute) \
+                    and owner.attr in ("mstate", "stack"):
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, int):
+                    pops += node.args[0].value
+                else:
+                    pops += 1
+        elif node.func.attr == "append":
+            owner = node.func.value
+            if isinstance(owner, ast.Attribute) and owner.attr == "stack":
+                appends += 1
+    return pops, appends
+
+
+class OpcodeSemanticsRule(LintRule):
+    code = "R4"
+    name = "opcode-semantics"
+    description = ("opcodes.py table, lockstep/symstep dispatch, and host "
+                   "instruction handlers must agree: byte-complete parity "
+                   "and consistent stack effects")
+
+    def run(self, ctx: LintContext) -> List[Violation]:
+        table = load_opcode_table()
+        violations: List[Violation] = []
+
+        refs: Dict[str, int] = {}
+        intervals: List[Tuple[int, int]] = []
+        unimplemented: Set[str] = set()
+        for relpath in INTERPRETERS:
+            tree = ctx.tree(os.path.join(ctx.repo_root, relpath))
+            violations.extend(check_interpreter_file(relpath, tree, table))
+            for name, lineno in collect_mnemonic_refs(tree).items():
+                refs.setdefault(name, lineno)
+            intervals.extend(collect_byte_intervals(tree))
+            unimplemented |= collect_unimplemented(tree)
+
+        for name in sorted(unimplemented):
+            if name not in table:
+                violations.append(Violation(
+                    "R4", INTERPRETERS[0], 1,
+                    f"UNIMPLEMENTED_OPS names unknown mnemonic {name!r}",
+                    where=name, key=f"R4:unimplemented:{name}"))
+
+        # byte-complete dispatch: dedupe aliases at the byte level
+        # (DIFFICULTY shares 0x44 with PREVRANDAO)
+        covered_bytes = {table[name][0] for name in refs if name in table}
+        covered_bytes |= {table[name][0] for name in unimplemented
+                         if name in table}
+        for lo, hi in intervals:
+            covered_bytes |= set(range(lo, hi + 1))
+        for name, (byte, _, _) in sorted(table.items()):
+            if byte not in covered_bytes:
+                violations.append(Violation(
+                    "R4", INTERPRETERS[0], 1,
+                    f"table opcode {name} (0x{byte:02X}) is neither "
+                    "dispatched by lockstep/symstep nor named in "
+                    "UNIMPLEMENTED_OPS — lanes hitting it fall into "
+                    "undefined behavior",
+                    where=name, key=f"R4:dispatch:{name}"))
+
+        violations.extend(self._check_handlers(ctx, table))
+        return violations
+
+    def check_paths(self, ctx: LintContext, paths) -> List[Violation]:
+        # only the refs-exist direction is per-file; dispatch coverage and
+        # handler stack effects are properties of the whole tree
+        table = load_opcode_table()
+        violations: List[Violation] = []
+        for path in paths:
+            violations.extend(check_interpreter_file(
+                ctx.relpath(path), ctx.tree(path), table))
+        return violations
+
+    def _check_handlers(self, ctx: LintContext,
+                        table: Dict[str, Tuple[int, int, int]]
+                        ) -> List[Violation]:
+        relpath = HANDLERS_PATH
+        tree = ctx.tree(os.path.join(ctx.repo_root, relpath))
+        handlers: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name.endswith("_") \
+                    and not node.name.startswith("_"):
+                handlers[node.name] = node
+
+        violations = []
+        for mnemonic, (byte, pops, pushes) in sorted(table.items()):
+            handler = handler_name_for(mnemonic)
+            fn = handlers.get(handler)
+            if fn is None:
+                violations.append(Violation(
+                    "R4", relpath, 1,
+                    f"no host handler {handler}() for table opcode "
+                    f"{mnemonic} (0x{byte:02X}) — the host engine raises "
+                    "InvalidInstruction where the device executes it",
+                    where=mnemonic, key=f"R4:handler:{mnemonic}"))
+                continue
+            if handler in STACK_CHECK_SKIP:
+                continue
+            counted_pops, counted_appends = handler_stack_effect(fn)
+            if counted_pops != pops:
+                violations.append(Violation(
+                    "R4", relpath, fn.lineno,
+                    f"{handler}() pops {counted_pops} but the table says "
+                    f"{mnemonic} pops {pops} — host-vs-lockstep stack "
+                    "drift (lockstep densifies POPS from the table)",
+                    where=mnemonic, key=f"R4:pops:{mnemonic}"))
+            if (pushes == 0) != (counted_appends == 0):
+                violations.append(Violation(
+                    "R4", relpath, fn.lineno,
+                    f"{handler}() appends {counted_appends} result(s) but "
+                    f"the table says {mnemonic} pushes {pushes} — "
+                    "host-vs-lockstep stack drift",
+                    where=mnemonic, key=f"R4:pushes:{mnemonic}"))
+        return violations

@@ -1,0 +1,105 @@
+"""R1 — no silent blanket exception swallows in the solver/device stack.
+
+Scans `mythril_tpu/smt/` and `mythril_tpu/parallel/` for `except` handlers
+that are BOTH broad (bare `except:`, `except Exception:`, or
+`except BaseException:`) AND silent (a body of only `pass`/`continue`/
+`...`). A handler like that erases the entire failure story the resilience
+subsystem exists to tell (support/resilience.py: every backend failure must
+be classified, logged, and counted) — it is exactly the pattern ISSUE 2
+replaced at smt/solver/solver.py:48.
+
+Audited survivors live in tools/lint/baseline.json keyed
+``R1:<file>:<enclosing def>`` — e.g. a ``__del__`` finalizer, where raising
+during interpreter teardown is worse than any leak. Add an entry only via
+``--baseline-update`` plus a written justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .. import LintContext, LintRule, Violation
+
+#: directories whose every .py file is linted (repo-relative)
+SCAN_DIRS = ("mythril_tpu/smt", "mythril_tpu/parallel")
+
+_BROAD = ("Exception", "BaseException")
+
+
+def is_broad(handler: ast.ExceptHandler) -> bool:
+    node = handler.type
+    if node is None:
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in _BROAD
+    if isinstance(node, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in node.elts)
+    return False
+
+
+def is_silent(handler: ast.ExceptHandler) -> bool:
+    return all(isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue)
+               or (isinstance(stmt, ast.Expr)
+                   and isinstance(stmt.value, ast.Constant)
+                   and stmt.value.value is Ellipsis)
+               for stmt in handler.body)
+
+
+def enclosing_function(tree: ast.AST, target: ast.AST) -> Optional[str]:
+    """Name of the innermost def/async def containing `target` (module
+    level -> None)."""
+    found: List[Optional[str]] = [None]
+
+    def descend(node: ast.AST, current: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if child is target:
+                found[0] = current
+                return
+            name = current
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = child.name
+            descend(child, name)
+
+    descend(tree, None)
+    return found[0]
+
+
+def check_file(relpath: str, tree: ast.AST) -> List[Violation]:
+    """All silent blanket excepts in one parsed file (no allowlisting —
+    suppression is the framework baseline's job)."""
+    violations = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not (is_broad(node) and is_silent(node)):
+            continue
+        function = enclosing_function(tree, node)
+        where = function or "<module>"
+        violations.append(Violation(
+            "R1", relpath, node.lineno,
+            f"silent blanket except in {where}() — classify and log the "
+            "failure (support/resilience.py) or narrow the except; "
+            "baseline in tools/lint/baseline.json only with justification",
+            where=where))
+    return violations
+
+
+class SilentExceptRule(LintRule):
+    code = "R1"
+    name = "silent-excepts"
+    description = ("no silent blanket `except Exception: pass` swallows in "
+                   "mythril_tpu/smt/ and mythril_tpu/parallel/")
+
+    def run(self, ctx: LintContext) -> List[Violation]:
+        violations = []
+        for path in ctx.iter_py(*SCAN_DIRS):
+            violations.extend(check_file(ctx.relpath(path), ctx.tree(path)))
+        return violations
+
+    def check_paths(self, ctx: LintContext, paths) -> List[Violation]:
+        violations = []
+        for path in paths:
+            violations.extend(check_file(ctx.relpath(path), ctx.tree(path)))
+        return violations

@@ -1,0 +1,332 @@
+"""R3 — trace-safety: the device hot path must never silently sync.
+
+Two classes of finding over ``mythril_tpu/parallel/``:
+
+1. **Traced scope** (hard violations): inside any function that jax traces
+   — jit/vmap/shard_map-wrapped, passed to a ``lax`` control-flow
+   combinator, or (transitively) called from such a function — the
+   following either crash at trace time or, worse, silently force a
+   device→host transfer on every call:
+
+   * ``.item()`` / ``.tolist()`` / ``.block_until_ready()``
+   * ``np.*`` calls (host numpy materializes the traced value)
+   * ``jax.device_get`` / ``np.asarray`` / ``np.array``
+   * ``int()`` / ``float()`` / ``bool()`` on a non-constant value
+   * Python ``if``/``while`` branching on a ``jnp``/``lax`` expression
+     (the branch executes at trace time, not per-lane — semantic drift,
+     or a ConcretizationTypeError at best)
+
+   ``if x is None`` checks on static arguments are fine and not flagged.
+
+2. **Host scope** (baseline-audited sync sites): every *explicit* sync
+   primitive — ``jax.device_get(...)``, ``.item()``, ``.tolist()``,
+   ``.block_until_ready()``, and ``bool()/int()/float()`` wrapped
+   directly around a ``jnp``/``lax`` expression (the trace-boundary
+   scalar fetch) — anywhere in ``parallel/`` must carry a baseline
+   justification proving it is a deliberate bulk transfer (one drain per
+   chunk) or a deliberate per-chunk control decision, not an accidental
+   per-element tunnel read. The
+   ~100 ms/transfer host tunnel is the single resource the frontier
+   design spends most carefully; unaudited sync sites are how it leaks.
+
+Keys: ``R3:<file>:<function>:<site>`` — line-number free so edits above a
+site don't churn the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .. import LintContext, LintRule, Violation
+
+SCAN_DIR = "mythril_tpu/parallel"
+
+#: attribute/function names whose call wraps a function for tracing
+TRACE_WRAPPERS = {"jit", "vmap", "pmap", "shard_map", "checkpoint", "remat"}
+
+#: jax.lax combinators whose function arguments are traced
+LAX_CONTROL = {"fori_loop", "scan", "while_loop", "cond", "switch", "map",
+               "associative_scan", "custom_root"}
+
+#: method calls that force a device->host sync
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+#: names numpy is commonly imported as
+NUMPY_ALIASES = {"np", "numpy", "onp"}
+
+#: names jax.numpy / jax.lax are commonly bound to
+DEVICE_NS = {"jnp", "lax", "jax"}
+
+
+def _func_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _ns_of(func: ast.AST) -> Optional[str]:
+    """Leading namespace of a call target: `np.asarray` -> 'np',
+    `jax.lax.scan` -> 'jax', bare name -> None."""
+    node = func
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _ModuleIndex:
+    """Per-module symbol tables the closure pass needs."""
+
+    def __init__(self, relpath: str, tree: ast.AST):
+        self.relpath = relpath
+        self.tree = tree
+        #: bare function name -> def node (top-level and class methods)
+        self.functions: Dict[str, ast.AST] = {}
+        #: local alias -> sibling module name ("A" -> "arena")
+        self.module_aliases: Dict[str, str] = {}
+        #: function names traced in this module (roots + closure)
+        self.traced: Set[str] = set()
+        #: lambda/def nodes directly handed to a tracer from host scope
+        self.traced_nodes: List[ast.AST] = []
+        self._index()
+
+    def _index(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, node)
+            elif isinstance(node, ast.ImportFrom):
+                # `from . import arena as A` / `from . import words`
+                if node.module in (None, "") or node.level:
+                    for alias in node.names:
+                        self.module_aliases[alias.asname or alias.name] = \
+                            alias.name
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    tail = alias.name.rsplit(".", 1)[-1]
+                    self.module_aliases.setdefault(
+                        alias.asname or tail, tail)
+
+    # -- root detection ----------------------------------------------------------
+
+    def _mark(self, node: ast.AST) -> None:
+        """Mark a function reference/literal as traced."""
+        if isinstance(node, ast.Name) and node.id in self.functions:
+            self.traced.add(node.id)
+        elif isinstance(node, (ast.Lambda, ast.FunctionDef,
+                               ast.AsyncFunctionDef)):
+            self.traced_nodes.append(node)
+        elif isinstance(node, ast.Call):
+            # jax.jit(jax.vmap(fn)) — unwrap nested wrapper calls
+            name = _func_name(node.func)
+            if name in TRACE_WRAPPERS or name == "partial":
+                for arg in node.args:
+                    self._mark(arg)
+
+    def find_roots(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    if self._is_trace_wrapper(deco):
+                        self.traced.add(node.name)
+            elif isinstance(node, ast.Call):
+                name = _func_name(node.func)
+                if name in TRACE_WRAPPERS:
+                    for arg in node.args:
+                        self._mark(arg)
+                elif name == "partial":
+                    # partial(jax.jit, ...) used as a decorator is caught
+                    # above; partial(fn) itself traces nothing
+                    pass
+                elif name in LAX_CONTROL and _ns_of(node.func) in DEVICE_NS:
+                    for arg in node.args:
+                        self._mark(arg)
+
+    def _is_trace_wrapper(self, deco: ast.AST) -> bool:
+        name = _func_name(deco)
+        if name in TRACE_WRAPPERS:
+            return True
+        if isinstance(deco, ast.Call):
+            inner = _func_name(deco.func)
+            if inner in TRACE_WRAPPERS:
+                return True
+            if inner == "partial" and deco.args \
+                    and _func_name(deco.args[0]) in TRACE_WRAPPERS:
+                return True
+        return False
+
+
+def _transitive_closure(indexes: Dict[str, _ModuleIndex]) -> None:
+    """Functions called (by bare name or module-alias attribute) from a
+    traced function are traced too — `step` via `lockstep.step`,
+    `alloc_rows` via `A.alloc_rows`."""
+    by_module = {idx.relpath.rsplit("/", 1)[-1][:-3]: idx
+                 for idx in indexes.values()}
+    work: List[Tuple[_ModuleIndex, ast.AST]] = []
+    seen: Set[Tuple[str, int]] = set()
+
+    def push(idx: _ModuleIndex, fn: ast.AST) -> None:
+        key = (idx.relpath, id(fn))
+        if key not in seen:
+            seen.add(key)
+            work.append((idx, fn))
+
+    for idx in indexes.values():
+        for name in idx.traced:
+            push(idx, idx.functions[name])
+        for node in idx.traced_nodes:
+            push(idx, node)
+
+    while work:
+        idx, fn = work.pop()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name) and func.id in idx.functions:
+                idx.traced.add(func.id)
+                push(idx, idx.functions[func.id])
+            elif isinstance(func, ast.Attribute) \
+                    and isinstance(func.value, ast.Name):
+                mod = idx.module_aliases.get(func.value.id)
+                target = by_module.get(mod) if mod else None
+                if target and func.attr in target.functions:
+                    target.traced.add(func.attr)
+                    push(target, target.functions[func.attr])
+
+
+def _test_touches_device(test: ast.AST) -> bool:
+    """Does a branch condition contain a jnp./lax./jax. call? (`x is None`
+    and plain-python comparisons are static and fine.)"""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call) and _ns_of(node.func) in DEVICE_NS:
+            return True
+    return False
+
+
+def _scan_traced_body(relpath: str, fn: ast.AST, fn_name: str
+                      ) -> List[Violation]:
+    violations = []
+
+    def add(node: ast.AST, site: str, detail: str) -> None:
+        violations.append(Violation(
+            "R3", relpath, node.lineno, detail, where=fn_name,
+            key=f"R3:{relpath}:{fn_name}:{site}"))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = _func_name(node.func)
+            ns = _ns_of(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and name in SYNC_METHODS and ns not in NUMPY_ALIASES:
+                add(node, name,
+                    f".{name}() inside traced function {fn_name}() forces "
+                    "a device->host sync on every trace evaluation — keep "
+                    "the value on device (jnp) or hoist to the host driver")
+            elif ns in NUMPY_ALIASES:
+                add(node, f"np.{name}",
+                    f"host numpy call np.{name}() inside traced function "
+                    f"{fn_name}() materializes the traced value — use "
+                    "jnp, or hoist the conversion to the host driver")
+            elif name == "device_get":
+                add(node, "device_get",
+                    f"jax.device_get inside traced function {fn_name}() — "
+                    "a traced value cannot be fetched mid-trace")
+            elif isinstance(node.func, ast.Name) \
+                    and name in ("int", "float", "bool") and node.args \
+                    and not isinstance(node.args[0], ast.Constant):
+                add(node, name,
+                    f"{name}() on a traced value in {fn_name}() raises "
+                    "ConcretizationTypeError under jit (or silently syncs "
+                    "outside it) — use astype()/jnp casts instead")
+        elif isinstance(node, (ast.If, ast.While)) \
+                and _test_touches_device(node.test):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            add(node, f"branch-{kind}",
+                f"Python `{kind}` on a jnp/lax expression in {fn_name}() "
+                "branches at trace time, not per lane — use jnp.where/"
+                "lax.cond so every lane keeps its own path")
+    return violations
+
+
+def _scan_host_syncs(relpath: str, tree: ast.AST,
+                     traced_fns: Set[str]) -> List[Violation]:
+    from .silent_excepts import enclosing_function
+
+    violations = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        site = None
+        name = _func_name(node.func)
+        if isinstance(node.func, ast.Attribute) and name in SYNC_METHODS \
+                and _ns_of(node.func) not in NUMPY_ALIASES:
+            site = name
+        elif name == "device_get":
+            site = "device_get"
+        elif isinstance(node.func, ast.Name) \
+                and name in ("int", "float", "bool") and node.args \
+                and _test_touches_device(node.args[0]):
+            site = f"{name}-of-device"
+        if site is None:
+            continue
+        fn = enclosing_function(tree, node) or "<module>"
+        if fn in traced_fns:
+            continue  # already reported as a traced-scope violation
+        violations.append(Violation(
+            "R3", relpath, node.lineno,
+            f"explicit host sync `{site}` in {fn}() — every sync site in "
+            "parallel/ must be a justified bulk transfer "
+            "(tools/lint/baseline.json), never a per-element tunnel read",
+            where=fn, key=f"R3:{relpath}:{fn}:{site}"))
+    return violations
+
+
+def analyze_modules(modules: Iterable[Tuple[str, ast.AST]]
+                    ) -> List[Violation]:
+    """Full R3 over a set of (relpath, tree) modules: root detection,
+    cross-module traced closure, traced-scope scan, host sync-site scan."""
+    indexes = {relpath: _ModuleIndex(relpath, tree)
+               for relpath, tree in modules}
+    for idx in indexes.values():
+        idx.find_roots()
+    _transitive_closure(indexes)
+
+    violations: List[Violation] = []
+    for idx in indexes.values():
+        seen_nodes = set()
+        for name in sorted(idx.traced):
+            fn = idx.functions[name]
+            seen_nodes.add(id(fn))
+            violations.extend(_scan_traced_body(idx.relpath, fn, name))
+        for node in idx.traced_nodes:
+            if id(node) not in seen_nodes:
+                label = getattr(node, "name", "<lambda>")
+                violations.extend(
+                    _scan_traced_body(idx.relpath, node, label))
+        violations.extend(
+            _scan_host_syncs(idx.relpath, idx.tree, idx.traced))
+    return violations
+
+
+class TraceSafetyRule(LintRule):
+    code = "R3"
+    name = "trace-safety"
+    description = ("no implicit host<->device syncs or trace-time branching "
+                   "in jit/vmap hot paths; explicit sync sites in parallel/ "
+                   "need a baseline justification")
+
+    def run(self, ctx: LintContext) -> List[Violation]:
+        modules = [(ctx.relpath(path), ctx.tree(path))
+                   for path in ctx.iter_py(SCAN_DIR)]
+        return analyze_modules(modules)
+
+    def check_paths(self, ctx: LintContext, paths) -> List[Violation]:
+        # the given files form one module group, so cross-file traced
+        # closure still works when a driver and its jitted helpers are
+        # passed together
+        return analyze_modules(
+            [(ctx.relpath(path), ctx.tree(path)) for path in paths])
