@@ -120,12 +120,24 @@ def main():
     seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 45.0
     import jax
 
+    from mythril_tpu.observe import trace
+
+    # every bench run leaves a Perfetto trace beside its BENCH_*.json
+    # (inspect with `python -m tools.traceview bench_trace.json`); an
+    # explicit MYTHRIL_TPU_TRACE wins
+    trace_path = os.environ.get("MYTHRIL_TPU_TRACE") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_trace.json")
+    trace.enable(trace_path)
+
     backend = jax.devices()[0].platform
+    trace.set_manifest(tool="bench.py", backend=backend,
+                       n_branches=N_BRANCHES, budget_s=seconds)
     _phase("devices", backend=backend, n=len(jax.devices()))
 
     # 1. host baseline first: pure Python, no compile risk — whatever happens
     #    later, the tail has the reference-architecture number
-    host_rate, host_info = _run_engine("host", seconds)
+    with trace.span("bench.host"):
+        host_rate, host_info = _run_engine("host", seconds)
     _phase("host", states_per_sec=round(host_rate, 1), **host_info)
 
     # 2. TPU warm-up: work-bounded (few fused chunks, small execution budget —
@@ -144,16 +156,19 @@ def main():
     os.environ["MYTHRIL_TPU_MAX_STEPS"] = "4096"
     os.environ["MYTHRIL_TPU_SKIP_HOST_DRAIN"] = "1"
     warm_start = time.perf_counter()
-    _run_engine("tpu", 150)
+    with trace.span("bench.tpu_warmup"):
+        _run_engine("tpu", 150)
     del os.environ["MYTHRIL_TPU_SKIP_HOST_DRAIN"]
     _phase("tpu_warmup", compile_s=round(time.perf_counter() - warm_start, 1))
 
     # 3. the measured TPU run on warm caches
     os.environ["MYTHRIL_TPU_MAX_STEPS"] = "65536"
-    tpu_rate, tpu_info = _run_engine("tpu", seconds)
+    with trace.span("bench.tpu"):
+        tpu_rate, tpu_info = _run_engine("tpu", seconds)
     _phase("tpu", states_per_sec=round(tpu_rate, 1), **tpu_info)
 
     if tpu_info["forks_on_device"] > 0 and tpu_rate > host_rate:
+        trace.export()
         print(json.dumps({
             "metric": "sym_states_per_sec",
             "value": round(tpu_rate, 1),
@@ -166,16 +181,20 @@ def main():
             "tpu": tpu_info,
             "host": host_info,
             "corpus": _corpus_extras(),
+            "trace": trace_path,
         }), flush=True)
         return
     # the symbolic frontier did not win wall-clock in this environment
     # (host-service sync costs dominate at small scale): report the concrete
     # lockstep throughput as the headline — a real, reproducible device
     # number — with the honest symbolic measurements attached as extras
-    lockstep_rate = bench_lockstep_concrete(seconds=min(seconds, 15.0))
+    with trace.span("bench.lockstep"):
+        lockstep_rate = bench_lockstep_concrete(seconds=min(seconds, 15.0))
     _phase("lockstep", steps_per_sec=round(lockstep_rate, 1))
-    oracle_rate = _oracle_concrete_rate(seconds=min(seconds, 10.0))
+    with trace.span("bench.oracle"):
+        oracle_rate = _oracle_concrete_rate(seconds=min(seconds, 10.0))
     _phase("oracle", steps_per_sec=round(oracle_rate, 1))
+    trace.export()
     print(json.dumps({
         "metric": "lockstep_lane_steps_per_sec",
         "value": round(lockstep_rate, 1),
@@ -188,6 +207,7 @@ def main():
         "sym_tpu": tpu_info,
         "sym_host": host_info,
         "corpus": _corpus_extras(),
+        "trace": trace_path,
     }), flush=True)
 
 

@@ -1,12 +1,18 @@
 """Benchmark plugin: states/sec + coverage over time (capability parity:
 mythril/laser/plugin/plugins/benchmark.py:19 — without the matplotlib dependency;
-emits a dict consumable by bench.py)."""
+emits a dict consumable by bench.py).
+
+Counters live on the observe metrics registry (``bench.instructions``,
+``bench.states_per_sec``) rather than private attributes, so the run report
+and traces see the same numbers; :attr:`nr_of_executed_insns` stays as a
+facade property for existing callers."""
 
 from __future__ import annotations
 
 import time
-from typing import Dict, List
+from typing import Dict
 
+from ....observe import metrics
 from ...state.global_state import GlobalState
 from ..builder import PluginBuilder
 from ..interface import LaserPlugin
@@ -14,13 +20,13 @@ from ..interface import LaserPlugin
 
 class BenchmarkPlugin(LaserPlugin):
     def __init__(self, name: str = "benchmark"):
-        self.nr_of_executed_insns = 0
+        metrics.reset("bench.")
         self.begin: float = 0.0
         self.end: float = 0.0
         self.points: Dict[float, int] = {}
 
     def initialize(self, symbolic_vm) -> None:
-        self.nr_of_executed_insns = 0
+        metrics.reset("bench.")
 
         @symbolic_vm.laser_hook("start_sym_exec")
         def start_hook():
@@ -29,12 +35,17 @@ class BenchmarkPlugin(LaserPlugin):
         @symbolic_vm.laser_hook("stop_sym_exec")
         def stop_hook():
             self.end = time.time()
+            metrics.set_gauge("bench.states_per_sec", self.states_per_second)
 
         @symbolic_vm.laser_hook("execute_state")
         def execute_state_hook(_: GlobalState):
-            self.nr_of_executed_insns += 1
+            metrics.inc("bench.instructions")
             self.points[round(time.time() - self.begin, 1)] = \
                 self.nr_of_executed_insns
+
+    @property
+    def nr_of_executed_insns(self) -> int:
+        return metrics.value("bench.instructions")
 
     @property
     def states_per_second(self) -> float:

@@ -3,7 +3,12 @@ mythril/laser/plugin/plugins/instruction_profiler.py:41).
 
 The engine is single-threaded and sequential, so one pending (opcode, start-time)
 slot suffices: each execute_state settles the previous instruction's timing and
-opens its own."""
+opens its own.
+
+Timings land on the observe metrics registry (``profiler.instruction_us``,
+one histogram label per opcode) instead of a private dict, so traceview and
+run manifests see them; :attr:`records` derives the legacy
+``opcode -> (min, max, total_seconds, count)`` mapping for existing callers."""
 
 from __future__ import annotations
 
@@ -11,6 +16,7 @@ import logging
 import time
 from typing import Dict, Optional, Tuple
 
+from ....observe import metrics
 from ...state.global_state import GlobalState
 from ..builder import PluginBuilder
 from ..interface import LaserPlugin
@@ -20,8 +26,7 @@ log = logging.getLogger(__name__)
 
 class InstructionProfiler(LaserPlugin):
     def __init__(self):
-        #: opcode -> (min, max, total_seconds, count)
-        self.records: Dict[str, Tuple[float, float, float, int]] = {}
+        metrics.reset("profiler.")  # a fresh profiler starts a fresh profile
         self._pending: Optional[Tuple[str, float]] = None
 
     def initialize(self, symbolic_vm) -> None:
@@ -43,17 +48,26 @@ class InstructionProfiler(LaserPlugin):
             return
         op, started = self._pending
         self._pending = None
-        elapsed = now - started
-        minimum, maximum, total, count = self.records.get(
-            op, (float("inf"), 0.0, 0.0, 0))
-        self.records[op] = (min(minimum, elapsed), max(maximum, elapsed),
-                            total + elapsed, count + 1)
+        metrics.observe("profiler.instruction_us", (now - started) * 1e6,
+                        label=op)
+
+    @property
+    def records(self) -> Dict[str, Tuple[float, float, float, int]]:
+        """opcode -> (min, max, total_seconds, count), derived from the
+        ``profiler.instruction_us`` histogram labels (legacy shape)."""
+        out: Dict[str, Tuple[float, float, float, int]] = {}
+        for op in metrics.labels("profiler.instruction_us"):
+            hist = metrics.histogram("profiler.instruction_us", op)
+            out[op] = (hist.min / 1e6, hist.max / 1e6, hist.total / 1e6,
+                       hist.count)
+        return out
 
     def report(self) -> str:
+        records = self.records
         lines = ["Instruction Perf Profile:"]
-        total_time = sum(rec[2] for rec in self.records.values())
+        total_time = sum(rec[2] for rec in records.values())
         for op, (minimum, maximum, total, count) in sorted(
-                self.records.items(), key=lambda kv: -kv[1][2]):
+                records.items(), key=lambda kv: -kv[1][2]):
             lines.append(
                 f"  [{total / max(total_time, 1e-12) * 100:6.2f} %] {op}: "
                 f"{count} calls, avg {total / count * 1e6:.1f}us, "

@@ -17,6 +17,7 @@ from datetime import datetime, timedelta
 from typing import Callable, Dict, List, Optional, Tuple, Union
 
 from ..exceptions import UnsatError
+from ..observe import trace
 from ..smt import Bool, symbol_factory
 from ..support.model import get_model
 from .instructions import Instruction, transfer_ether
@@ -152,8 +153,11 @@ class LaserEVM:
             log.info("starting contract creation transaction")
             self.time = datetime.now()
             time_handler.start_execution(self.create_timeout or self.execution_timeout)
-            created_account = execute_contract_creation(
-                self, creation_code, contract_name)
+            with trace.span("svm.create_tx",
+                            contract=contract_name or "") as create_span:
+                created_account = execute_contract_creation(
+                    self, creation_code, contract_name)
+                create_span.set(open_states=len(self.open_states))
             log.info("finished contract creation, found %d open states",
                      len(self.open_states))
             if not self.open_states:
@@ -204,7 +208,9 @@ class LaserEVM:
                              "%d, %d states", i, len(self.work_list))
                     for hook in self._start_sym_trans_hooks:
                         hook()
-                    self.exec()
+                    with trace.span("svm.tx", index=i, resumed=True,
+                                    states=len(self.work_list)):
+                        self.exec()
                     for hook in self._stop_sym_trans_hooks:
                         hook()
                     self._save_checkpoint(tx_index=i + 1)
@@ -226,12 +232,15 @@ class LaserEVM:
                 hook()
             hashes = (predicted_hashes[i]
                       if i < len(predicted_hashes) else None)
-            if self.engine == "tpu":
-                from ..parallel.frontier import execute_message_call_tpu
+            with trace.span("svm.tx", index=i, engine=self.engine,
+                            states=len(self.open_states)):
+                if self.engine == "tpu":
+                    from ..parallel.frontier import execute_message_call_tpu
 
-                execute_message_call_tpu(self, address, func_hashes=hashes)
-            else:
-                execute_message_call(self, address, func_hashes=hashes)
+                    execute_message_call_tpu(self, address,
+                                             func_hashes=hashes)
+                else:
+                    execute_message_call(self, address, func_hashes=hashes)
             for hook in self._stop_sym_trans_hooks:
                 hook()
             self._save_checkpoint(tx_index=i + 1)

@@ -93,6 +93,12 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
                               "native_crash, divergence, host_crash) at the "
                               "NTH visit of its boundary (N, N+, or *; "
                               "default 1); comma-separate multiple entries")
+    options.add_argument("--trace-out", default=None, metavar="PATH",
+                         help="write a Chrome/Perfetto trace_event JSON of "
+                              "the run (phases, device flushes, XLA "
+                              "compiles) to PATH; same as MYTHRIL_TPU_TRACE; "
+                              "inspect with `python -m tools.traceview PATH` "
+                              "or load at https://ui.perfetto.dev")
     options.add_argument("--device-crosscheck", type=int, default=0,
                          metavar="N",
                          help="re-decide every Nth device sat/unsat verdict "

@@ -12,6 +12,7 @@ from typing import List, Optional
 from ..analysis.report import Issue, Report
 from ..analysis.security import fire_lasers, retrieve_callback_issues
 from ..analysis.symbolic import SymExecWrapper
+from ..observe import trace
 from ..smt.solver.solver_statistics import SolverStatistics
 from ..support.support_args import args
 from ..support.loader import DynLoader
@@ -70,6 +71,18 @@ class MythrilAnalyzer:
         from ..support import resilience
 
         resilience.configure(args.inject_fault)
+        # span tracer: --trace-out wins over MYTHRIL_TPU_TRACE (observe/)
+        from ..support import tpu_config
+
+        trace_out = getattr(cmd, "trace_out", None) \
+            or tpu_config.get_str("MYTHRIL_TPU_TRACE")
+        if trace_out:
+            trace.enable(trace_out)
+            trace.set_manifest(
+                engine=self.engine, strategy=strategy,
+                solver=getattr(args, "solver", "cdcl"),
+                execution_timeout=self.execution_timeout,
+                contracts=", ".join(c.name for c in self.contracts))
 
     def _dynloader(self):
         if self.use_onchain_data and self.eth is not None:
@@ -117,6 +130,9 @@ class MythrilAnalyzer:
         for contract in self.contracts:
             SolverStatistics().reset()
             sym = None
+            contract_span = trace.span("analyze.contract",
+                                       contract=contract.name)
+            contract_span.__enter__()
             try:
                 sym = SymExecWrapper(
                     contract,
@@ -143,6 +159,7 @@ class MythrilAnalyzer:
                 log.exception("exception during %s analysis", contract.name)
                 exceptions.append(traceback.format_exc())
                 issues = retrieve_callback_issues(modules)
+            contract_span.__exit__(None, None, None)
             log.info("solver statistics: %s", SolverStatistics())
             laser = getattr(sym, "laser", None)
             if laser is not None and getattr(laser, "timed_out", False):
@@ -171,6 +188,10 @@ class MythrilAnalyzer:
         report.coverage = coverage
         for issue in all_issues:
             report.append_issue(issue)
+        # flush a partial trace now (the atexit hook rewrites the final one;
+        # an exporting analyzer embedded in a longer process still leaves a
+        # loadable file behind)
+        trace.export()
         return report
 
 

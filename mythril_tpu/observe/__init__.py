@@ -1,0 +1,31 @@
+"""mythril_tpu.observe — unified tracing + metrics (ISSUE 5 tentpole).
+
+Two halves, both process-wide singletons, both near-zero-cost when idle:
+
+* :mod:`~mythril_tpu.observe.trace` — a low-overhead span tracer
+  (``trace.span("device_flush", attrs=...)`` context manager,
+  ``trace.traced`` decorator, ``trace.instant`` point events) backed by a
+  thread-safe ring buffer, exporting Chrome/Perfetto ``trace_event`` JSON.
+  Enabled by ``MYTHRIL_TPU_TRACE=out.json`` or ``analyze --trace-out``;
+  when disabled, ``span()`` returns a shared no-op singleton — no event,
+  no timestamp, no allocation beyond the call itself.
+* :mod:`~mythril_tpu.observe.metrics` — a typed metrics registry
+  (counters / gauges / histograms, each declared with name + unit + doc,
+  mirroring the ``support/tpu_config.py`` knob-registry shape).
+  ``SolverStatistics`` fields are facade properties over this registry,
+  so every existing caller and test keeps working while the data gains a
+  single declared home. tpu-lint rule R6 (tools/lint/rules/
+  metrics_registry.py) fails the build on any emission of an undeclared
+  metric name.
+
+``python -m tools.traceview trace.json`` renders per-phase wall-time
+rollups, device-flush occupancy/latency histograms, and XLA-compile
+accounting from an exported trace. See README "Observability".
+
+Both modules are stdlib-only: the lint framework and the traceview CLI
+load them without importing jax or the rest of the package.
+"""
+
+from . import metrics, trace  # noqa: F401
+
+__all__ = ["metrics", "trace"]

@@ -1,0 +1,298 @@
+"""Typed metrics registry: every metric the engine emits is declared here.
+
+Same contract shape as the ``support/tpu_config.py`` knob registry: a
+metric has a name, a kind (``counter`` | ``gauge`` | ``histogram``), a
+unit, and a one-line docstring; emitting an undeclared name raises
+``KeyError`` at runtime, and tpu-lint rule R6
+(tools/lint/rules/metrics_registry.py) fails the build on any literal
+emission of a name missing from :data:`REGISTRY` — a typo'd metric is
+loud twice instead of silently graphing nothing forever.
+
+``SolverStatistics`` (smt/solver/solver_statistics.py) is a facade over
+this store: its scalar fields are properties reading/writing the
+registry values, so `stats.query_count += 1` and
+`metrics.value("solver.queries")` are the same number.
+
+Counters accumulate (ints stay ints until a float lands — existing tests
+compare with ``==``), gauges hold the last value, histograms keep
+count/sum/min/max plus a bounded reservoir of recent observations and an
+optional per-label breakdown (e.g. per-opcode instruction latency).
+
+This module must stay dependency-free (stdlib only): the lint framework
+and ``tools/traceview.py`` load it standalone, without importing jax or
+the rest of the package.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+#: recent observations kept per histogram (aggregates are unbounded)
+RESERVOIR = 4096
+
+
+class MetricSpec(NamedTuple):
+    """One declared metric."""
+
+    name: str   #: dotted name, "<subsystem>.<metric>"
+    kind: str   #: "counter" | "gauge" | "histogram"
+    unit: str   #: "1", "s", "ms", "us", "clauses", "queries", "rows", ...
+    doc: str    #: one-line description
+
+
+_METRICS: List[MetricSpec] = [
+    # -- solver core (SolverStatistics facade) -----------------------------------
+    MetricSpec("solver.queries", COUNTER, "1",
+               "Solver check() calls (stat_smt_query decorator)."),
+    MetricSpec("solver.time", COUNTER, "s",
+               "Cumulative wall time inside solver checks."),
+    MetricSpec("solver.device.queries", COUNTER, "1",
+               "Queries routed to the device SAT backend."),
+    MetricSpec("solver.device.solved", COUNTER, "1",
+               "Device queries decided SAT/UNSAT on device."),
+    MetricSpec("solver.device.fallbacks", COUNTER, "1",
+               "Device queries handed to the CDCL ladder (UNKNOWN/failure)."),
+    MetricSpec("solver.last_query_clauses", GAUGE, "clauses",
+               "CNF size of the most recent blasted query."),
+    # -- word-level simplification (smt/solver/simplify.py) ----------------------
+    MetricSpec("simplify.time", COUNTER, "s",
+               "Wall time inside the word-level simplification pass."),
+    MetricSpec("simplify.iterations", COUNTER, "1",
+               "Fixpoint iterations across all simplification passes."),
+    MetricSpec("simplify.rewrites", COUNTER, "1",
+               "Total terms rewritten by the simplifier."),
+    MetricSpec("simplify.const_props", COUNTER, "1",
+               "Constants propagated through asserted equalities."),
+    MetricSpec("simplify.keccak_rewrites", COUNTER, "1",
+               "Keccak equalities decided via injectivity/disjointness."),
+    MetricSpec("simplify.ite_collapses", COUNTER, "1",
+               "ITE ladders folded branch-wise."),
+    MetricSpec("simplify.selects_bounded", COUNTER, "1",
+               "Symbolic-index selects answered by bounded enumeration."),
+    MetricSpec("simplify.extract_fusions", COUNTER, "1",
+               "Extract/Concat fusions and zext/sext eliminations."),
+    MetricSpec("simplify.clauses_avoided", COUNTER, "clauses",
+               "Estimated CNF clauses avoided by simplification."),
+    # -- batched device dispatch (smt/solver/dispatch.py) ------------------------
+    MetricSpec("dispatch.submitted", COUNTER, "1",
+               "SAT queries submitted to the dispatch queue."),
+    MetricSpec("dispatch.cache_hits", COUNTER, "1",
+               "Submissions answered from the canonical-CNF verdict cache."),
+    MetricSpec("dispatch.dedup_hits", COUNTER, "1",
+               "Submissions merged into an identical in-flight entry."),
+    MetricSpec("dispatch.flushes", COUNTER, "1",
+               "Batched device flushes."),
+    MetricSpec("dispatch.flushed_queries", COUNTER, "1",
+               "Unique queries carried by batched flushes."),
+    MetricSpec("dispatch.device_time", COUNTER, "s",
+               "Wall seconds inside device batch calls."),
+    MetricSpec("dispatch.flush.occupancy", HISTOGRAM, "queries",
+               "Unique queries per batched device flush."),
+    MetricSpec("dispatch.flush.latency_ms", HISTOGRAM, "ms",
+               "Wall time of one batched device flush."),
+    # -- resilience / failure domains (support/resilience.py) --------------------
+    MetricSpec("resilience.device_skipped", COUNTER, "1",
+               "Queries skipped because a breaker was OPEN/QUARANTINED."),
+    MetricSpec("resilience.breaker_trips", COUNTER, "1",
+               "Circuit-breaker CLOSED->OPEN transitions."),
+    MetricSpec("resilience.breaker_recoveries", COUNTER, "1",
+               "Half-open probes that closed a breaker again."),
+    MetricSpec("resilience.crosschecks", COUNTER, "1",
+               "Device verdicts re-decided on the host oracle."),
+    MetricSpec("resilience.divergences", COUNTER, "1",
+               "Crosschecks where the device verdict was disproven."),
+    # -- XLA compile accounting (parallel/jax_solver.py) -------------------------
+    MetricSpec("xla.bucket_compiles", COUNTER, "1",
+               "Solver runner invocations on a never-seen clause-shape "
+               "bucket (pays XLA compile or persistent-cache load)."),
+    MetricSpec("xla.bucket_reuses", COUNTER, "1",
+               "Solver runner invocations on an already-compiled bucket."),
+    # -- device frontier (parallel/frontier.py) ----------------------------------
+    MetricSpec("frontier.chunks", COUNTER, "1",
+               "Fused lockstep chunks dispatched to the device."),
+    MetricSpec("frontier.cold_sloads", COUNTER, "1",
+               "Lanes paused on a cold SLOAD serviced by the host."),
+    MetricSpec("frontier.drain.rows", HISTOGRAM, "rows",
+               "Escape rows fetched per bulk host drain."),
+    # -- checkpoints (support/checkpoint.py, parallel/frontier.py) ---------------
+    MetricSpec("checkpoint.saves", COUNTER, "1",
+               "Crash-safe checkpoint writes (host pickle + device npz)."),
+    MetricSpec("checkpoint.write_ms", HISTOGRAM, "ms",
+               "Wall time of one checkpoint write."),
+    # -- engine plugins (core/plugin/plugins/) -----------------------------------
+    MetricSpec("profiler.instruction_us", HISTOGRAM, "us",
+               "Per-opcode host-engine instruction latency "
+               "(label = opcode; instruction-profiler plugin)."),
+    MetricSpec("bench.instructions", COUNTER, "1",
+               "Instructions executed under the benchmark plugin."),
+    MetricSpec("bench.states_per_sec", GAUGE, "states/s",
+               "Benchmark plugin throughput at stop_sym_exec."),
+]
+
+REGISTRY: Dict[str, MetricSpec] = {spec.name: spec for spec in _METRICS}
+
+
+def declared(name: str) -> bool:
+    """True when `name` is a registered metric."""
+    return name in REGISTRY
+
+
+def _spec(name: str, *kinds: str) -> MetricSpec:
+    spec = REGISTRY[name]  # KeyError on undeclared names is the contract
+    if kinds and spec.kind not in kinds:
+        raise TypeError(
+            f"{name} is declared as {spec.kind!r}, not {'/'.join(kinds)!r}")
+    return spec
+
+
+class _Hist:
+    """Histogram state: aggregates + bounded reservoir."""
+
+    __slots__ = ("count", "total", "min", "max", "recent")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.recent = deque(maxlen=RESERVOIR)
+
+    def add(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+        self.recent.append(value)
+
+    def as_dict(self) -> dict:
+        if not self.count:
+            return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                    "avg": 0.0}
+        return {"count": self.count, "sum": self.total, "min": self.min,
+                "max": self.max, "avg": self.total / self.count}
+
+
+class _Store:
+    """Process-wide metric values (single store, like SolverStatistics)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.scalars: Dict[str, float] = {}
+        #: name -> label -> _Hist ("" = unlabeled)
+        self.hists: Dict[str, Dict[str, _Hist]] = {}
+
+
+_STORE = _Store()
+
+
+def inc(name: str, value=1) -> None:
+    """Add `value` to a declared counter."""
+    _spec(name, COUNTER)
+    with _STORE.lock:
+        _STORE.scalars[name] = _STORE.scalars.get(name, 0) + value
+
+
+def set_gauge(name: str, value) -> None:
+    """Set a declared gauge to `value`."""
+    _spec(name, GAUGE)
+    with _STORE.lock:
+        _STORE.scalars[name] = value
+
+
+def observe(name: str, value, label: str = "") -> None:
+    """Record one observation on a declared histogram (optionally under a
+    label, e.g. an opcode name)."""
+    _spec(name, HISTOGRAM)
+    with _STORE.lock:
+        by_label = _STORE.hists.setdefault(name, {})
+        hist = by_label.get(label)
+        if hist is None:
+            hist = by_label[label] = _Hist()
+        hist.add(value)
+
+
+def value(name: str):
+    """Current value of a declared counter or gauge (0 when never set)."""
+    _spec(name, COUNTER, GAUGE)
+    return _STORE.scalars.get(name, 0)
+
+
+def set_value(name: str, new_value) -> None:
+    """Absolute assignment on a counter or gauge — the facade-property
+    write path (``stats.query_count = 0``). Dynamic-name API: rule R6
+    only audits literal emissions through inc/set_gauge/observe."""
+    _spec(name, COUNTER, GAUGE)
+    with _STORE.lock:
+        _STORE.scalars[name] = new_value
+
+
+def histogram(name: str, label: str = "") -> Optional[_Hist]:
+    """The _Hist for (name, label), or None when nothing was observed."""
+    _spec(name, HISTOGRAM)
+    return _STORE.hists.get(name, {}).get(label)
+
+
+def labels(name: str) -> List[str]:
+    """Labels observed on a declared histogram."""
+    _spec(name, HISTOGRAM)
+    return sorted(_STORE.hists.get(name, {}))
+
+
+def snapshot() -> dict:
+    """Every declared metric's current state, JSON-shaped (run manifests,
+    bench extras, traceview)."""
+    out: Dict[str, object] = {}
+    with _STORE.lock:
+        for spec in _METRICS:
+            if spec.kind == HISTOGRAM:
+                by_label = _STORE.hists.get(spec.name)
+                if not by_label:
+                    continue
+                if set(by_label) == {""}:
+                    out[spec.name] = by_label[""].as_dict()
+                else:
+                    out[spec.name] = {label: hist.as_dict()
+                                      for label, hist in
+                                      sorted(by_label.items())}
+            else:
+                raw = _STORE.scalars.get(spec.name, 0)
+                if raw:
+                    out[spec.name] = raw
+    return out
+
+
+def reset(prefix: str = "") -> None:
+    """Zero every metric whose name starts with `prefix` ("" = all).
+    SolverStatistics.reset() clears its own subsystems; plugins clear
+    theirs at initialize()."""
+    with _STORE.lock:
+        for name in list(_STORE.scalars):
+            if name.startswith(prefix):
+                _STORE.scalars[name] = 0
+        for name in list(_STORE.hists):
+            if name.startswith(prefix):
+                del _STORE.hists[name]
+
+
+def render_markdown_table() -> str:
+    """The declared-metrics table (README "Observability" section)."""
+    lines = [
+        "| Metric | Kind | Unit | Meaning |",
+        "| --- | --- | --- | --- |",
+    ]
+    for spec in _METRICS:
+        lines.append(f"| `{spec.name}` | {spec.kind} | {spec.unit} "
+                     f"| {spec.doc} |")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(render_markdown_table())

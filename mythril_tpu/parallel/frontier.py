@@ -44,6 +44,7 @@ import numpy as np
 
 from ..core.state.global_state import GlobalState
 from ..exceptions import UnsatError
+from ..observe import metrics, trace
 from ..smt import Bool, Extract, symbol_factory
 from ..smt import terms as T
 from ..support import tpu_config
@@ -611,8 +612,12 @@ class _Frontier:
                 log.info("device budget fraction (%.0f%%) consumed; the "
                          "host continuation takes over", frac * 100)
                 break
-            state, planes, self.arena, sched = symstep.run_chunk(
-                state, planes, self.arena, sched, chunk)
+            # the dispatch itself is async — the span bounds enqueue time;
+            # the blocking device wait lands in the frontier.sync span below
+            with trace.span("frontier.chunk", steps=chunk):
+                state, planes, self.arena, sched = symstep.run_chunk(
+                    state, planes, self.arena, sched, chunk)
+            metrics.inc("frontier.chunks")
             steps += chunk
             # PIPELINE: the chunk dispatch above is async — materialize the
             # previously-fetched escape rows NOW, while the device steps
@@ -624,8 +629,9 @@ class _Frontier:
             # else stays in HBM (the tunnel: ~30 ms floor PER ARRAY +
             # ~35 MB/s down, ~100 ms floor up — per-service host decisions
             # and multi-leaf fetches are unaffordable)
-            packed = np.asarray(jax.device_get(
-                _summary_compiled()(state, planes, self.arena, sched)))
+            with trace.span("frontier.sync"):
+                packed = np.asarray(jax.device_get(
+                    _summary_compiled()(state, planes, self.arena, sched)))
             (stack_top, esc_count, executed, forks, pushes, pops, arena_n,
              arena_nc, esc_msize, esc_sp, esc_slots, esc_conds, _batch) = (
                  int(v) for v in packed[:13])
@@ -640,9 +646,12 @@ class _Frontier:
             # cold-SLOAD pauses need a host fault-in to progress at all
             cold = np.nonzero((status == FORKING) & (fork_cond == 0))[0]
             if len(cold):
-                harena = self._harena(arena_n, arena_nc)
-                state, planes = self._service_cold(
-                    state, planes, status, [int(l) for l in cold], harena)
+                metrics.inc("frontier.cold_sloads", len(cold))
+                with trace.span("frontier.service_cold", lanes=len(cold)):
+                    harena = self._harena(arena_n, arena_nc)
+                    state, planes = self._service_cold(
+                        state, planes, status, [int(l) for l in cold],
+                        harena)
                 dirty = True
             # escape-buffer overflow: lanes frozen ESCAPED are packed off
             # to the deferred queue (lazy materialization) and freed
@@ -668,9 +677,12 @@ class _Frontier:
             if esc_count >= drain_batch or (
                     esc_count and stack_top == 0
                     and not (status == RUNNING).any()):
-                backlog = self._fetch_escapes(sched, esc_count, esc_msize,
-                                              esc_sp, esc_slots, esc_conds,
-                                              arena_n, arena_nc)
+                metrics.observe("frontier.drain.rows", esc_count)
+                with trace.span("frontier.fetch_escapes", rows=esc_count):
+                    backlog = self._fetch_escapes(sched, esc_count,
+                                                  esc_msize, esc_sp,
+                                                  esc_slots, esc_conds,
+                                                  arena_n, arena_nc)
                 sched = _reset_esc_compiled()(sched)
                 esc_count = 0
             # host overflow rows re-enter once the device stack is empty
@@ -966,9 +978,10 @@ class _Frontier:
         if backlog is None:
             return
         pack_handle, delta_handle, count = backlog
-        self.harena.refresh_apply(delta_handle)
-        rows_state, rows_planes = self._pack_apply(pack_handle)
-        self.deferred.append([rows_state, rows_planes, count, 0])
+        with trace.span("frontier.host_drain", rows=count):
+            self.harena.refresh_apply(delta_handle)
+            rows_state, rows_planes = self._pack_apply(pack_handle)
+            self.deferred.append([rows_state, rows_planes, count, 0])
 
     def make_feeder(self, batch_rows: int = 256):
         """Refill callback for the svm exec loop: materialize up to
@@ -1371,10 +1384,18 @@ class _Frontier:
             sys_module.setrecursionlimit(limit)
         from ..support.checkpoint import fsync_replace
 
-        tmp = f"{path}.tmp"
-        with open(tmp, "wb") as handle:
-            np.savez_compressed(handle, **arrays)
-        fsync_replace(tmp, path)
+        import time as time_module
+
+        started = time_module.perf_counter()
+        with trace.span("checkpoint.save", kind="device",
+                        pending_rows=len(pending_rows)):
+            tmp = f"{path}.tmp"
+            with open(tmp, "wb") as handle:
+                np.savez_compressed(handle, **arrays)
+            fsync_replace(tmp, path)
+        metrics.inc("checkpoint.saves")
+        metrics.observe("checkpoint.write_ms",
+                        (time_module.perf_counter() - started) * 1000.0)
 
     def load_checkpoint(self, path: str):
         """Restore (state, planes) saved by save_checkpoint; the arena and
@@ -1382,7 +1403,8 @@ class _Frontier:
         on an identity mismatch (checkpoint from a different seeding)."""
         if not path.endswith(".npz"):
             path += ".npz"
-        data = np.load(path)
+        with trace.span("checkpoint.load", kind="device"):
+            data = np.load(path)
         n_lanes, n_contexts = (int(v) for v in data["identity"])
         if n_lanes != self.n_lanes or n_contexts != len(self.contexts):
             raise ValueError(
@@ -1468,6 +1490,8 @@ class _Frontier:
             return
         if not len(live) and not backlog:
             return
+        trace.instant("frontier.hand_over", live_lanes=len(live),
+                      backlog_rows=backlog)
         harena = self._harena()
         if len(live):
             self._materialize_lanes(state, planes, harena, live)
@@ -1549,8 +1573,11 @@ def execute_message_call_tpu(laser_evm, callee_address,
     lane_budget = tpu_config.get_int("MYTHRIL_TPU_LANES", DEFAULT_LANES)
     frontier = _Frontier(laser_evm,
                          n_lanes=max(lane_budget, 2 * len(seeds)))
-    state, planes = frontier.seed(seeds)
-    frontier.run(state, planes)
+    with trace.span("frontier.seed", seeds=len(seeds)):
+        state, planes = frontier.seed(seeds)
+    with trace.span("frontier.device_phase", lanes=frontier.n_lanes) as ph:
+        frontier.run(state, planes)
+        ph.set(forks=frontier.forks, lane_steps=frontier.lane_steps)
     log.info("frontier: %d forks, %d storage fault-ins, %d infeasible "
              "pruned, %d states materialized + %d deferred for the host "
              "(arena nodes: %d, stack pushes/pops %d/%d, host "
@@ -1577,7 +1604,8 @@ def execute_message_call_tpu(laser_evm, callee_address,
     # cost, exactly like the host engine's own states at timeout
     laser_evm.frontier_feeder = frontier.make_feeder()
     try:
-        laser_evm.exec()
+        with trace.span("frontier.host_continuation"):
+            laser_evm.exec()
     finally:
         laser_evm.frontier_feeder = None
         if frontier.deferred:

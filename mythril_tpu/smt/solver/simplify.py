@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from .. import terms
+from ...observe import trace
 from .solver_statistics import SolverStatistics
 
 #: UF namespace the keccak function manager owns; applications are injective by
@@ -105,21 +106,23 @@ def simplify_constraints(constraints: Iterable[terms.Term]) -> SimplifyOutcome:
     conjuncts = _flatten(list(key))
     substitutions: Dict[terms.Term, terms.Term] = {}
     iterations = 0
-    if conjuncts and conjuncts[0] is terms.FALSE:
-        outcome = SimplifyOutcome([terms.FALSE])
-    else:
-        while iterations < MAX_ITERATIONS:
-            iterations += 1
-            new_conjuncts = _iterate(conjuncts, substitutions, counters)
-            changed = len(new_conjuncts) != len(conjuncts) or any(
-                a is not b for a, b in zip(new_conjuncts, conjuncts))
-            conjuncts = new_conjuncts
-            if conjuncts and conjuncts[0] is terms.FALSE:
-                break
-            if not changed:
-                break
-        outcome = SimplifyOutcome(conjuncts, substitutions, iterations,
-                                  counters.rewrites)
+    with trace.span("simplify.pass", conjuncts=len(key)) as pass_span:
+        if conjuncts and conjuncts[0] is terms.FALSE:
+            outcome = SimplifyOutcome([terms.FALSE])
+        else:
+            while iterations < MAX_ITERATIONS:
+                iterations += 1
+                new_conjuncts = _iterate(conjuncts, substitutions, counters)
+                changed = len(new_conjuncts) != len(conjuncts) or any(
+                    a is not b for a, b in zip(new_conjuncts, conjuncts))
+                conjuncts = new_conjuncts
+                if conjuncts and conjuncts[0] is terms.FALSE:
+                    break
+                if not changed:
+                    break
+            outcome = SimplifyOutcome(conjuncts, substitutions, iterations,
+                                      counters.rewrites)
+        pass_span.set(iterations=iterations, rewrites=counters.rewrites)
 
     statistics.simplify_time += time.time() - started
     statistics.simplify_iterations += iterations

@@ -1,10 +1,60 @@
 """Per-process solver query statistics (API parity:
-mythril/laser/smt/solver/solver_statistics.py:29 + stat_smt_query:8)."""
+mythril/laser/smt/solver/solver_statistics.py:29 + stat_smt_query:8).
+
+Since ISSUE 5 this class is a *facade* over the typed metrics registry
+(mythril_tpu/observe/metrics.py): every scalar field is a property whose
+value lives in the registry under a declared metric name, so
+``stats.query_count += 1`` and ``metrics.value("solver.queries")`` are
+one number and the run report, the bench JSON, and the traceview rollup
+all read the same store. Container-shaped state (failure_counts,
+batch_bucket_shapes, backends_quarantined) has no scalar metric shape
+and stays on the instance. Existing callers and tests are unchanged —
+integer counters stay integers until a float lands.
+"""
 
 from __future__ import annotations
 
 import time
 from functools import wraps
+
+from ...observe import metrics
+
+#: scalar field -> declared metric (observe/metrics.py REGISTRY); these
+#: become facade properties on SolverStatistics below
+FACADE_METRICS = {
+    "query_count": "solver.queries",
+    "solver_time": "solver.time",
+    "device_queries": "solver.device.queries",
+    "device_solved": "solver.device.solved",
+    "device_fallbacks": "solver.device.fallbacks",
+    #: CNF size of the most recent blasted query (one-shot: full blast;
+    #: incremental: clauses shipped for that check) — lets tests pin the
+    #: post-simplification clause count of a specific query
+    "last_query_clauses": "solver.last_query_clauses",
+    # word-level simplification pass (smt/solver/simplify.py)
+    "simplify_time": "simplify.time",
+    "simplify_iterations": "simplify.iterations",
+    "simplify_rewrites": "simplify.rewrites",
+    "simplify_constants_propagated": "simplify.const_props",
+    "simplify_keccak_rewrites": "simplify.keccak_rewrites",
+    "simplify_ite_collapses": "simplify.ite_collapses",
+    "simplify_selects_bounded": "simplify.selects_bounded",
+    "simplify_extract_fusions": "simplify.extract_fusions",
+    "simplify_clauses_avoided": "simplify.clauses_avoided",
+    # failure domains + circuit breaker (support/resilience.py)
+    "device_skipped": "resilience.device_skipped",
+    "breaker_trips": "resilience.breaker_trips",
+    "breaker_recoveries": "resilience.breaker_recoveries",
+    "crosschecks": "resilience.crosschecks",
+    "divergences": "resilience.divergences",
+    # batched device dispatch (smt/solver/dispatch.py)
+    "batch_submitted": "dispatch.submitted",
+    "batch_cache_hits": "dispatch.cache_hits",
+    "batch_dedup_hits": "dispatch.dedup_hits",
+    "batch_flushes": "dispatch.flushes",
+    "batch_flushed_queries": "dispatch.flushed_queries",
+    "batch_device_time": "dispatch.device_time",
+}
 
 
 class SolverStatistics:
@@ -16,58 +66,14 @@ class SolverStatistics:
         if cls._instance is None:
             cls._instance = super().__new__(cls)
             cls._instance.enabled = False
-            cls._instance.query_count = 0
-            cls._instance.solver_time = 0.0
-            cls._instance.device_queries = 0
-            cls._instance.device_fallbacks = 0
-            cls._instance.device_solved = 0
-            cls._instance._init_simplify()
-            cls._instance._init_resilience()
-            cls._instance._init_batch()
+            cls._instance._init_containers()
         return cls._instance
 
-    def _init_simplify(self) -> None:
-        # word-level simplification pass (smt/solver/simplify.py)
-        self.simplify_time = 0.0
-        self.simplify_iterations = 0
-        self.simplify_rewrites = 0
-        self.simplify_constants_propagated = 0
-        self.simplify_keccak_rewrites = 0
-        self.simplify_ite_collapses = 0
-        self.simplify_selects_bounded = 0
-        self.simplify_extract_fusions = 0
-        self.simplify_clauses_avoided = 0
-        #: CNF size of the most recent blasted query (one-shot: full blast;
-        #: incremental: clauses shipped for that check) — lets tests pin the
-        #: post-simplification clause count of a specific query
-        self.last_query_clauses = 0
-
-    def _init_resilience(self) -> None:
-        # failure domains + circuit breaker (support/resilience.py)
+    def _init_containers(self) -> None:
+        """The non-scalar state with no metric shape (dict/list/set)."""
         #: classified failures keyed "backend:class" (e.g. "device:device_oom")
         self.failure_counts = {}
-        #: queries skipped because a backend's breaker was OPEN/QUARANTINED
-        self.device_skipped = 0
-        self.breaker_trips = 0
-        self.breaker_recoveries = 0
-        #: sampled device-verdict cross-checks against the host oracle
-        self.crosschecks = 0
-        self.divergences = 0
         self.backends_quarantined = []
-
-    def _init_batch(self) -> None:
-        # batched device dispatch (smt/solver/dispatch.py)
-        #: total submissions, including ones answered by cache/dedup
-        self.batch_submitted = 0
-        #: submissions answered from the canonical-CNF verdict cache
-        self.batch_cache_hits = 0
-        #: submissions merged into an identical in-flight queue entry
-        self.batch_dedup_hits = 0
-        #: device flushes and the unique queries they carried
-        self.batch_flushes = 0
-        self.batch_flushed_queries = 0
-        #: wall seconds inside device batch calls (amortized latency numerator)
-        self.batch_device_time = 0.0
         #: distinct (n_tiles, v1, padded_batch) shapes the batch runner
         #: compiled — the XLA compile-cache pressure the pow2 bucketing bounds
         self.batch_bucket_shapes = set()
@@ -93,14 +99,9 @@ class SolverStatistics:
         }
 
     def reset(self) -> None:
-        self.query_count = 0
-        self.solver_time = 0.0
-        self.device_queries = 0
-        self.device_fallbacks = 0
-        self.device_solved = 0
-        self._init_simplify()
-        self._init_resilience()
-        self._init_batch()
+        for metric_name in FACADE_METRICS.values():
+            metrics.set_value(metric_name, 0)
+        self._init_containers()
 
     def __repr__(self):
         out = (f"Solver statistics: query count: {self.query_count}, "
@@ -120,14 +121,14 @@ class SolverStatistics:
                     f"{self.simplify_extract_fusions} extract/concat, "
                     f"~{self.simplify_clauses_avoided} clauses avoided)")
         if self.batch_submitted:
-            metrics = self.batch_metrics()
-            out += (f", batch dispatch: {metrics['submitted']} submitted "
-                    f"(cache hit rate: {metrics['cache_hit_rate']:.1%}, "
-                    f"dedup hits: {metrics['dedup_hits']}, "
-                    f"occupancy: {metrics['occupancy']}/flush over "
-                    f"{metrics['flushes']} flushes, "
-                    f"buckets compiled: {metrics['buckets_compiled']}, "
-                    f"amortized: {metrics['amortized_ms_per_query']} "
+            batch = self.batch_metrics()
+            out += (f", batch dispatch: {batch['submitted']} submitted "
+                    f"(cache hit rate: {batch['cache_hit_rate']:.1%}, "
+                    f"dedup hits: {batch['dedup_hits']}, "
+                    f"occupancy: {batch['occupancy']}/flush over "
+                    f"{batch['flushes']} flushes, "
+                    f"buckets compiled: {batch['buckets_compiled']}, "
+                    f"amortized: {batch['amortized_ms_per_query']} "
                     f"ms/query)")
         if self.failure_counts or self.breaker_trips or self.device_skipped:
             classified = ", ".join(f"{key}={count}" for key, count
@@ -142,6 +143,21 @@ class SolverStatistics:
         if self.backends_quarantined:
             out += f", QUARANTINED backends: {self.backends_quarantined}"
         return out
+
+
+def _facade_property(metric_name: str) -> property:
+    def fget(self):
+        return metrics.value(metric_name)
+
+    def fset(self, new_value):
+        metrics.set_value(metric_name, new_value)
+
+    return property(fget, fset)
+
+
+for _field, _metric in FACADE_METRICS.items():
+    setattr(SolverStatistics, _field, _facade_property(_metric))
+del _field, _metric
 
 
 def stat_smt_query(func):

@@ -29,9 +29,11 @@ import logging
 import os
 import pickle
 import sys
+import time
 from typing import Optional
 
 from . import tpu_config
+from ..observe import metrics, trace
 
 log = logging.getLogger(__name__)
 
@@ -109,13 +111,20 @@ def save_host_checkpoint(path: str, laser, tx_index: int,
     }
     limit = sys.getrecursionlimit()
     sys.setrecursionlimit(max(limit, 200_000))  # deep store/constraint chains
+    started = time.perf_counter()
     try:
-        tmp = f"{path}.tmp"
-        with open(tmp, "wb") as handle:
-            pickle.dump(payload, handle, protocol=4)
-        fsync_replace(tmp, path)
+        with trace.span("checkpoint.save", kind="host", tx_index=tx_index,
+                        open_states=len(payload["open_states"]),
+                        work_list=len(payload["work_list"])):
+            tmp = f"{path}.tmp"
+            with open(tmp, "wb") as handle:
+                pickle.dump(payload, handle, protocol=4)
+            fsync_replace(tmp, path)
     finally:
         sys.setrecursionlimit(limit)
+    metrics.inc("checkpoint.saves")
+    metrics.observe("checkpoint.write_ms",
+                    (time.perf_counter() - started) * 1000.0)
 
 
 def load_host_checkpoint(path: str) -> Optional[dict]:
@@ -124,7 +133,8 @@ def load_host_checkpoint(path: str) -> Optional[dict]:
     if not os.path.exists(path):
         return None
     try:
-        with open(path, "rb") as handle:
+        with trace.span("checkpoint.load", kind="host"), \
+                open(path, "rb") as handle:
             payload = pickle.load(handle)
         if not isinstance(payload, dict):
             log.warning("checkpoint %s is not a payload dict (%s); ignoring",

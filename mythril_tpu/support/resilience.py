@@ -38,6 +38,7 @@ import logging
 from typing import Callable, Dict, List, Optional, Tuple
 
 from . import tpu_config
+from ..observe import trace
 
 log = logging.getLogger(__name__)
 
@@ -216,6 +217,7 @@ class BackendHealth:
             self.skipped_since_trip = 0
             self.recoveries += 1
             _stats().breaker_recoveries += 1
+            trace.instant("resilience.breaker_recovery", backend=self.name)
             log.warning("backend %r recovered: circuit breaker closed",
                         self.name)
 
@@ -240,6 +242,9 @@ class BackendHealth:
             self.skipped_since_trip = 0
             self.trips += 1
             stats.breaker_trips += 1
+            trace.instant("resilience.breaker_trip", backend=self.name,
+                          failure_class=failure_class,
+                          consecutive=self.consecutive_failures)
             log.error(
                 "backend %r circuit breaker TRIPPED after %d consecutive "
                 "failures (last: %s %s) — degrading to the next ladder rung",
@@ -254,6 +259,8 @@ class BackendHealth:
         stats = _stats()
         if self.name not in stats.backends_quarantined:
             stats.backends_quarantined.append(self.name)
+        trace.instant("resilience.quarantine", backend=self.name,
+                      detail=detail or "verdict divergence")
         log.critical(
             "backend %r QUARANTINED for the rest of this run: %s — all "
             "further queries use the host ladder", self.name,

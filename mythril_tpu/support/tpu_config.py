@@ -95,6 +95,13 @@ _KNOBS: List[Knob] = [
          "~/.mythril_tpu)."),
     Knob("MYTHRIL_TPU_RPC", "str", None,
          "Default RPC endpoint preset for dynamic loading."),
+    # -- observability (mythril_tpu/observe/) -------------------------------------
+    Knob("MYTHRIL_TPU_TRACE", "str", None,
+         "Write a Chrome/Perfetto trace_event JSON to this path; setting "
+         "it enables the span tracer (observe/trace.py)."),
+    Knob("MYTHRIL_TPU_TRACE_BUFFER", "int", 65536,
+         "Span-tracer ring-buffer capacity in events; beyond it the "
+         "oldest events drop (counted in the export)."),
     # -- test corpora -------------------------------------------------------------
     Knob("MYTHRIL_TPU_VMTESTS", "str", None,
          "Root of the ethereum/tests VMTests corpus for parity suites."),

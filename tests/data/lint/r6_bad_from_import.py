@@ -1,0 +1,8 @@
+"""R6 bad fixture: an emitter function from-imported (and aliased) out of
+the metrics module still gets audited."""
+
+from mythril_tpu.observe.metrics import inc as bump
+
+
+def emit():
+    bump("solver.queries_typo")

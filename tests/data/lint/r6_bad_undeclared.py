@@ -1,0 +1,10 @@
+"""R6 bad fixture: emissions naming metrics missing from the observe
+registry, through a module alias (inc / set_gauge / observe)."""
+
+from mythril_tpu.observe import metrics
+
+
+def emit():
+    metrics.inc("solver.warp_speed")
+    metrics.set_gauge("frontier.vibes", 11)
+    metrics.observe("dispatch.flux_capacitance", 1.21)

@@ -1,0 +1,18 @@
+"""R6 clean fixture: declared-metric emissions are fine; `.observe(...)`
+on objects that are NOT the metrics module (jax tracers, watchdogs) and
+dynamic names are out of scope."""
+
+from mythril_tpu.observe import metrics
+
+
+class Watcher:
+    def observe(self, event):
+        return event
+
+
+def emit(watcher: Watcher, name: str):
+    metrics.inc("solver.queries")
+    metrics.set_gauge("solver.last_query_clauses", 42)
+    metrics.observe("dispatch.flush.occupancy", 16)
+    watcher.observe("anything.goes")  # not the metrics module
+    metrics.set_value(name, 0)  # dynamic-name facade path: runtime contract

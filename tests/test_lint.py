@@ -29,10 +29,10 @@ if REPO_ROOT not in sys.path:
 from tools.lint import (Baseline, LintContext, LintRule,  # noqa: E402
                         RuleDiscovery, Violation, run_lint)
 from tools.lint.rules import (dispatch_bypass, env_knobs,  # noqa: E402
-                              opcode_semantics, silent_excepts,
-                              trace_safety)
+                              metrics_registry, opcode_semantics,
+                              silent_excepts, trace_safety)
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
 
 
 def _tree(text, filename="<fixture>"):
@@ -135,6 +135,11 @@ def _r5(name):
                                 env_knobs.load_registry())
 
 
+def _r6(name):
+    return metrics_registry.check_file(name, _fixture_tree(name),
+                                       metrics_registry.load_registry())
+
+
 @pytest.mark.parametrize("runner,fixture,expected_sites", [
     (_r1, "r1_bad_silent_pass.py", {"drain"}),
     (_r1, "r1_bad_bare_continue.py", {"poll", "<module>"}),
@@ -148,6 +153,9 @@ def _r5(name):
      {"MYTHRIL_TPU_TURBO", "MYTHRIL_TPU_SPEED"}),
     (_r5, "r5_bad_getenv.py",
      {"MYTHRIL_TPU_MISSPELLED", "MYTHRIL_TPU_NOT_A_KNOB"}),
+    (_r6, "r6_bad_undeclared.py",
+     {"solver.warp_speed", "frontier.vibes", "dispatch.flux_capacitance"}),
+    (_r6, "r6_bad_from_import.py", {"solver.queries_typo"}),
 ])
 def test_bad_fixture_fires(runner, fixture, expected_sites):
     violations = runner(fixture)
@@ -163,6 +171,7 @@ def test_bad_fixture_fires(runner, fixture, expected_sites):
     (_r3, "r3_clean.py"),
     (_r4, "r4_clean.py"),
     (_r5, "r5_clean.py"),
+    (_r6, "r6_clean.py"),
 ])
 def test_clean_fixture_is_quiet(runner, fixture):
     assert runner(fixture) == []

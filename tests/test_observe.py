@@ -1,0 +1,320 @@
+"""Unit tests for mythril_tpu/observe/: the span tracer (Perfetto export,
+disabled-mode fast path, ring-buffer drop accounting) and the typed
+metrics registry (declared-name contract, counters/gauges/histograms,
+SolverStatistics facade back-compat), plus one cheap end-to-end host-engine
+run proving the exported trace is loadable and its spans cover the run.
+"""
+
+import json
+import os
+
+import pytest
+
+from mythril_tpu.observe import metrics, trace
+from mythril_tpu.smt.solver.solver_statistics import (FACADE_METRICS,
+                                                      SolverStatistics,
+                                                      stat_smt_query)
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability(monkeypatch):
+    """Tracer and metric store are process singletons: every test starts
+    and ends from the never-touched state."""
+    monkeypatch.delenv("MYTHRIL_TPU_TRACE", raising=False)
+    monkeypatch.delenv("MYTHRIL_TPU_TRACE_BUFFER", raising=False)
+    trace.reset()
+    metrics.reset()
+    SolverStatistics().reset()
+    yield
+    trace.reset()
+    metrics.reset()
+    SolverStatistics().reset()
+
+
+# -- tracer: disabled fast path ------------------------------------------------------
+
+
+def test_disabled_span_is_one_shared_null_object():
+    """The disabled-mode contract: no event, no timestamp, no per-call
+    allocation — every call site gets the SAME null span."""
+    assert not trace.enabled()
+    assert trace.span("a") is trace.span("b", attr=1)
+    with trace.span("c") as sp:
+        assert sp.set(x=1) is sp  # .set is a chainable no-op
+
+
+def test_disabled_decorator_and_instant_are_noops():
+    calls = []
+
+    @trace.traced("never.recorded")
+    def work(x):
+        calls.append(x)
+        return x * 2
+
+    assert work(21) == 42
+    trace.instant("never.recorded")
+    assert calls == [21]
+    assert trace.export() is None  # disabled export: no path, no file
+
+
+def test_decorator_sees_tracer_enabled_after_definition(tmp_path):
+    """The enabled check is per CALL: functions decorated at import time
+    still record once the tracer turns on later."""
+
+    @trace.traced("late.bind")
+    def work():
+        return 7
+
+    work()  # disabled: nothing recorded
+    out = str(tmp_path / "late.json")
+    trace.enable(out)
+    work()
+    doc = json.load(open(trace.export()))
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert names == ["late.bind"]
+
+
+# -- tracer: recording + export ------------------------------------------------------
+
+
+def test_export_is_valid_perfetto_trace_event_json(tmp_path):
+    out = str(tmp_path / "trace.json")
+    trace.enable(out)
+    with trace.span("svm.tx", index=0):
+        with trace.span("dispatch.flush", occupancy=4) as flush:
+            flush.set(decided=3)
+    trace.instant("resilience.breaker_trip", backend="device")
+    trace.set_manifest(backend="cpu", argv="pytest")
+    assert trace.export() == out
+
+    doc = json.load(open(out))
+    assert set(doc) == {"traceEvents", "displayTimeUnit", "otherData"}
+    events = doc["traceEvents"]
+    # process/thread metadata present
+    meta = [e for e in events if e["ph"] == "M"]
+    assert {"process_name", "thread_name"} <= {e["name"] for e in meta}
+    # X events carry numeric ts/dur in us, a cat, and pid/tid
+    spans = [e for e in events if e["ph"] == "X"]
+    assert [e["name"] for e in spans] == ["dispatch.flush", "svm.tx"]
+    for event in spans:
+        assert isinstance(event["ts"], (int, float))
+        assert isinstance(event["dur"], (int, float))
+        assert event["cat"] == event["name"].split(".", 1)[0]
+        assert "pid" in event and "tid" in event
+    flush_event, tx_event = spans
+    assert flush_event["args"] == {"occupancy": 4, "decided": 3}
+    # nesting: the inner span lies within the outer one
+    assert tx_event["ts"] <= flush_event["ts"]
+    assert flush_event["ts"] + flush_event["dur"] \
+        <= tx_event["ts"] + tx_event["dur"] + 1e-3
+    # instants are thread-scoped
+    instants = [e for e in events if e["ph"] == "i"]
+    assert len(instants) == 1
+    assert instants[0]["s"] == "t"
+    assert instants[0]["args"]["backend"] == "device"
+    # manifest + accounting
+    assert doc["otherData"]["backend"] == "cpu"
+    assert doc["otherData"]["dropped_events"] == 0
+    assert doc["otherData"]["total_events"] == 3
+
+
+def test_env_knob_enables_tracer_at_first_use(tmp_path, monkeypatch):
+    out = str(tmp_path / "env.json")
+    monkeypatch.setenv("MYTHRIL_TPU_TRACE", out)
+    trace.reset()  # back to never-touched: env re-checked at next use
+    with trace.span("svm.tx"):
+        pass
+    assert trace.enabled()
+    assert trace.out_path() == out
+    assert trace.export() == out
+    assert os.path.exists(out)
+
+
+def test_ring_buffer_drops_oldest_and_counts_them(tmp_path, monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_TRACE_BUFFER", "64")  # clamps to 1024
+    out = str(tmp_path / "drop.json")
+    trace.enable(out)
+    for i in range(1500):
+        with trace.span("tiny.span", i=i):
+            pass
+    doc = json.load(open(trace.export()))
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == 1024
+    assert doc["otherData"]["total_events"] == 1500
+    assert doc["otherData"]["dropped_events"] == 1500 - 1024
+    # the oldest events dropped: the survivors are the most recent ones
+    assert spans[0]["args"]["i"] == 1500 - 1024
+
+
+def test_export_overwrites_atomically_and_is_idempotent(tmp_path):
+    out = str(tmp_path / "twice.json")
+    trace.enable(out)
+    with trace.span("a.one"):
+        pass
+    trace.export()
+    with trace.span("a.two"):
+        pass
+    doc = json.load(open(trace.export()))
+    assert [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"] \
+        == ["a.one", "a.two"]
+    assert not os.path.exists(out + ".tmp")
+
+
+# -- metrics registry ----------------------------------------------------------------
+
+
+def test_undeclared_metric_is_loud():
+    # dynamic names on purpose: R6 would (rightly) flag these as literals
+    undeclared = "solver.not_a_metric"
+    with pytest.raises(KeyError):
+        metrics.inc(undeclared)
+    with pytest.raises(KeyError):
+        metrics.set_gauge(undeclared, 1)
+    with pytest.raises(KeyError):
+        metrics.observe(undeclared, 1.0)
+    with pytest.raises(KeyError):
+        metrics.value(undeclared)
+
+
+def test_kind_mismatch_is_loud():
+    with pytest.raises(TypeError):
+        metrics.inc("dispatch.flush.occupancy")  # histogram, not counter
+    with pytest.raises(TypeError):
+        metrics.set_gauge("solver.queries", 3)  # counter, not gauge
+    with pytest.raises(TypeError):
+        metrics.observe("solver.queries", 3)  # counter, not histogram
+    with pytest.raises(TypeError):
+        metrics.value("dispatch.flush.occupancy")  # histograms have no value
+
+
+def test_counters_stay_int_until_a_float_lands():
+    metrics.inc("solver.queries")
+    metrics.inc("solver.queries", 2)
+    assert metrics.value("solver.queries") == 3
+    assert isinstance(metrics.value("solver.queries"), int)
+    metrics.inc("solver.time", 0.25)
+    assert metrics.value("solver.time") == 0.25
+
+
+def test_histogram_labels_and_aggregates():
+    metrics.observe("profiler.instruction_us", 10.0, label="ADD")
+    metrics.observe("profiler.instruction_us", 30.0, label="ADD")
+    metrics.observe("profiler.instruction_us", 5.0, label="SSTORE")
+    assert metrics.labels("profiler.instruction_us") == ["ADD", "SSTORE"]
+    hist = metrics.histogram("profiler.instruction_us", "ADD")
+    assert hist.as_dict() == {"count": 2, "sum": 40.0, "min": 10.0,
+                              "max": 30.0, "avg": 20.0}
+    assert metrics.histogram("profiler.instruction_us", "MUL") is None
+
+
+def test_snapshot_shape_and_prefix_reset():
+    metrics.inc("dispatch.flushes")
+    metrics.observe("dispatch.flush.occupancy", 8)
+    metrics.inc("frontier.chunks", 5)
+    snap = metrics.snapshot()
+    assert snap["dispatch.flushes"] == 1
+    assert snap["dispatch.flush.occupancy"]["count"] == 1
+    assert snap["frontier.chunks"] == 5
+    metrics.reset("dispatch.")
+    assert metrics.value("dispatch.flushes") == 0
+    assert metrics.histogram("dispatch.flush.occupancy") is None
+    assert metrics.value("frontier.chunks") == 5  # other prefixes untouched
+
+
+def test_every_facade_field_is_declared():
+    for metric_name in FACADE_METRICS.values():
+        assert metrics.declared(metric_name), metric_name
+    assert metrics.render_markdown_table().startswith("| Metric |")
+
+
+# -- SolverStatistics facade back-compat ---------------------------------------------
+
+
+def test_facade_fields_mirror_the_metric_store():
+    stats = SolverStatistics()
+    stats.query_count += 2
+    stats.device_queries += 1
+    assert metrics.value("solver.queries") == 2
+    assert metrics.value("solver.device.queries") == 1
+    metrics.inc("solver.queries", 3)  # writes on either side are one number
+    assert stats.query_count == 5
+    assert isinstance(stats.query_count, int)
+
+
+def test_facade_reset_zeroes_scalars_and_reinits_containers():
+    stats = SolverStatistics()
+    stats.batch_submitted += 7
+    stats.failure_counts["device:device_oom"] = 2
+    stats.backends_quarantined.append("device")
+    stats.batch_bucket_shapes.add((8, 256, 4))
+    stats.reset()
+    assert stats.batch_submitted == 0
+    assert stats.failure_counts == {}
+    assert stats.backends_quarantined == []
+    assert stats.batch_bucket_shapes == set()
+
+
+def test_stat_smt_query_decorator_counts_and_times():
+    stats = SolverStatistics()
+
+    @stat_smt_query
+    def check():
+        return "sat"
+
+    assert check() == "sat"
+    assert check() == "sat"
+    assert stats.query_count == 2
+    assert stats.solver_time >= 0.0
+
+
+def test_batch_metrics_and_repr_preserve_legacy_shapes():
+    stats = SolverStatistics()
+    stats.batch_submitted += 12
+    stats.batch_cache_hits += 3
+    stats.batch_flushes += 2
+    stats.batch_flushed_queries += 9
+    stats.batch_bucket_shapes.add((8, 256, 8))
+    batch = stats.batch_metrics()
+    assert batch["submitted"] == 12
+    assert batch["occupancy"] == 4.5
+    assert batch["cache_hit_rate"] == 0.25
+    assert batch["buckets_compiled"] == 1
+    stats.query_count += 2
+    text = repr(stats)
+    assert "query count: 2," in text  # ints print as ints, not 2.0
+    assert "12 submitted" in text
+
+
+# -- end to end: a real host-engine run exports a loadable trace ---------------------
+
+
+def test_host_engine_run_exports_covering_trace(tmp_path):
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.frontends.asm import assemble, creation_wrapper
+
+    import tools.traceview as traceview
+
+    out = str(tmp_path / "run.json")
+    trace.enable(out)
+    creation = creation_wrapper(assemble(
+        "PUSH1 0x00\nCALLDATALOAD\nPUSH1 0x2a\nEQ\nPUSH @yes\nJUMPI\nSTOP\n"
+        "yes:\nJUMPDEST\nPUSH1 0x01\nPUSH1 0x00\nSSTORE\nSTOP"))
+    SymExecWrapper(
+        creation.hex(), address=None, strategy="bfs", max_depth=64,
+        execution_timeout=30, create_timeout=15, transaction_count=1,
+        compulsory_statespace=False, run_analysis_modules=False)
+    path = trace.export()
+
+    events, other = traceview.load_trace(path)
+    spans = [e for e in events if e["ph"] == "X"]
+    names = {e["name"] for e in spans}
+    assert "svm.create_tx" in names
+    assert "svm.tx" in names
+    # the engine-phase spans cover (>= 90%) of the traced wall window
+    covered, wall = traceview.merged_coverage(spans)
+    assert wall > 0
+    assert covered / wall >= 0.9, f"span coverage {covered / wall:.1%}"
+    # and the report renders a rollup over them
+    text = traceview.report(events, other)
+    assert "== per-phase wall time ==" in text
+    assert "svm.tx" in text

@@ -1,0 +1,120 @@
+"""tools/traceview.py run-report CLI: golden-fixture rollups, coverage
+math, text histograms, and CLI exit codes (0 ok / 2 unreadable)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.traceview import (_fmt_us, load_trace, main, merged_coverage,
+                             report, rollup, text_histogram)
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "trace",
+                      "golden_trace.json")
+
+
+def test_load_trace_object_format():
+    events, other = load_trace(GOLDEN)
+    assert len(events) == 8
+    assert other["backend"] == "cpu"
+    assert other["dropped_events"] == 0
+
+
+def test_load_trace_bare_array_format(tmp_path):
+    path = tmp_path / "bare.json"
+    path.write_text(json.dumps([
+        {"ph": "X", "name": "a.b", "ts": 0, "dur": 10},
+    ]))
+    events, other = load_trace(str(path))
+    assert len(events) == 1
+    assert other == {}
+
+
+@pytest.mark.parametrize("payload", [
+    '{"foo": 1}',                     # object without traceEvents
+    '"just a string"',                # not an array or object
+    '[{"name": "no-ph-field"}]',      # event missing "ph"
+])
+def test_load_trace_rejects_non_trace_documents(tmp_path, payload):
+    path = tmp_path / "bad.json"
+    path.write_text(payload)
+    with pytest.raises(ValueError):
+        load_trace(str(path))
+
+
+def test_rollup_on_golden_fixture():
+    events, _ = load_trace(GOLDEN)
+    spans = [e for e in events if e["ph"] == "X"]
+    by_name = {row["name"]: row for row in rollup(spans, lambda s: s["name"])}
+    flush = by_name["dispatch.flush"]
+    assert flush["count"] == 3
+    assert flush["total_us"] == 300000.0
+    assert flush["mean_us"] == 100000.0
+    assert flush["max_us"] == 150000.0
+    # sorted by total descending: the 1s svm.tx span leads
+    assert rollup(spans, lambda s: s["cat"])[0]["name"] == "svm"
+
+
+def test_merged_coverage_counts_overlaps_once():
+    events, _ = load_trace(GOLDEN)
+    spans = [e for e in events if e["ph"] == "X"]
+    covered, wall = merged_coverage(spans)
+    # every other span nests inside the 0..1s svm.tx span
+    assert wall == 1000000.0
+    assert covered == 1000000.0
+    # disjoint intervals: gaps stay uncovered
+    covered, wall = merged_coverage([
+        {"ts": 0, "dur": 100}, {"ts": 300, "dur": 100},
+    ])
+    assert (covered, wall) == (200.0, 400.0)
+    assert merged_coverage([]) == (0.0, 0.0)
+
+
+def test_report_sections_on_golden_fixture():
+    events, other = load_trace(GOLDEN)
+    text = report(events, other)
+    assert "== run manifest ==" in text
+    assert "contracts: GoldenContract" in text
+    assert "span coverage: 100.0%" in text
+    assert "flushes: 3, queries: 32, mean occupancy: 10.67/flush" in text
+    assert "1 first-call bucket(s)" in text
+    assert "('batch', 4, 256, 2, 512, 16)" in text
+    assert "resilience.breaker_trip" in text
+    assert "failure_class=device_oom" in text
+
+
+def test_fmt_us_adaptive_units():
+    assert _fmt_us(500) == "500us"
+    assert _fmt_us(1500) == "1.5ms"
+    assert _fmt_us(2_000_000) == "2.00s"
+
+
+def test_text_histogram_shapes():
+    assert text_histogram([]) == ["  (no observations)"]
+    flat = text_histogram([5.0, 5.0, 5.0])
+    assert len(flat) == 1 and flat[0].endswith("| 3")
+    lines = text_histogram([1.0, 2.0, 3.0, 10.0], n_bins=4)
+    assert len(lines) == 4
+    # every observation lands in exactly one bin
+    assert sum(int(line.rsplit("|", 1)[1]) for line in lines) == 4
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    assert main([GOLDEN]) == 0
+    assert "== per-phase wall time ==" in capsys.readouterr().out
+    assert main([str(tmp_path / "missing.json")]) == 2
+    junk = tmp_path / "junk.json"
+    junk.write_text("not json {{{")
+    assert main([str(junk)]) == 2
+    assert "traceview: cannot read" in capsys.readouterr().err
+
+
+def test_cli_module_invocation():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.traceview", GOLDEN],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0
+    assert "== per-span rollup ==" in proc.stdout

@@ -23,6 +23,9 @@ Rules (see ``tools/lint/rules/``):
 * **R5 env-knobs** — every ``MYTHRIL_TPU_*`` env read must be declared in
   the ``mythril_tpu/support/tpu_config.py`` registry, and the README knob
   table must match the registry rendering.
+* **R6 metrics-registry** — every metric emitted through
+  ``observe.metrics`` (``inc`` / ``set_gauge`` / ``observe``) must name a
+  metric declared in ``mythril_tpu/observe/metrics.py``.
 
 Run ``python -m tools.lint`` (exit 1 on violations), or via the tier-1
 suite (tests/test_lint.py). Known, audited violations live in

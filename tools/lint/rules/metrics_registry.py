@@ -1,0 +1,139 @@
+"""R6 — metric hygiene: every emitted metric names a declared metric.
+
+The observe metrics registry (``mythril_tpu/observe/metrics.py``) is the
+single source of truth for metric names, kinds, units, and docs — exactly
+as R5 makes ``tpu_config.py`` the source of truth for env knobs. An
+emission of an undeclared name would raise ``KeyError`` at runtime, but
+only on the code path that emits it; this rule moves that failure to lint
+time, for every path, including the cold ones tests never walk.
+
+Checked: every call to ``inc`` / ``set_gauge`` / ``observe`` on a module
+imported from ``mythril_tpu.observe`` (``metrics.inc(...)``, an aliased
+``from ... import metrics as m``, or a from-imported ``inc(...)``) whose
+first argument is a string literal must name a metric in ``REGISTRY``.
+Dynamic names (the ``set_value`` facade write path, loops over
+``FACADE_METRICS``) are the registry's runtime ``KeyError`` contract's
+problem, not this rule's.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import List, Set
+
+from .. import REPO_ROOT, LintContext, LintRule, Violation
+
+METRICS_PATH = "mythril_tpu/observe/metrics.py"
+SCAN_DIRS = ("mythril_tpu", "tools", "tests", "bench.py")
+
+#: emission calls whose first positional argument is a metric name
+EMITTERS = ("inc", "set_gauge", "observe")
+
+
+def load_registry() -> Set[str]:
+    """Declared metric names, loaded straight from observe/metrics.py by
+    file path (stdlib-only module; never drags jax in)."""
+    path = os.path.join(REPO_ROOT, METRICS_PATH)
+    spec = importlib.util.spec_from_file_location(
+        "_tpu_lint_observe_metrics", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return set(module.REGISTRY)
+
+
+def _metric_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to the metrics MODULE: ``from x.observe import
+    metrics [as m]`` and ``import mythril_tpu.observe.metrics as m``."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "observe"
+                                or node.module.endswith(".observe")):
+                for name in node.names:
+                    if name.name == "metrics":
+                        aliases.add(name.asname or name.name)
+        elif isinstance(node, ast.Import):
+            for name in node.names:
+                if name.name.endswith(".observe.metrics"):
+                    aliases.add(name.asname or name.name.split(".", 1)[0])
+    return aliases
+
+
+def _emitter_imports(tree: ast.AST) -> Set[str]:
+    """Local names bound to emitter FUNCTIONS from the metrics module:
+    ``from x.observe.metrics import inc [as bump]``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                (node.module == "metrics"
+                 or node.module.endswith(".metrics")):
+            for name in node.names:
+                if name.name in EMITTERS:
+                    out.add(name.asname or name.name)
+    return out
+
+
+def check_file(relpath: str, tree: ast.AST,
+               registry: Set[str]) -> List[Violation]:
+    aliases = _metric_aliases(tree)
+    emitters = _emitter_imports(tree)
+    if not aliases and not emitters:
+        return []
+    violations: List[Violation] = []
+
+    def check_call(node: ast.Call, how: str) -> None:
+        if not node.args:
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value not in registry:
+            violations.append(Violation(
+                "R6", relpath, node.lineno,
+                f"{how} emits undeclared metric {arg.value!r} — declare "
+                "it in mythril_tpu/observe/metrics.py (name, kind, unit, "
+                "docstring) or fix the typo; undeclared emissions raise "
+                "KeyError at runtime",
+                where=arg.value, key=f"R6:{relpath}:{arg.value}"))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in EMITTERS \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in aliases:
+            check_call(node, f"{func.value.id}.{func.attr}")
+        elif isinstance(func, ast.Name) and func.id in emitters:
+            check_call(node, func.id)
+    return violations
+
+
+class MetricsRegistryRule(LintRule):
+    code = "R6"
+    name = "metrics-registry"
+    description = ("every metric emitted via observe.metrics "
+                   "inc/set_gauge/observe must be declared in "
+                   "mythril_tpu/observe/metrics.py")
+
+    def run(self, ctx: LintContext) -> List[Violation]:
+        registry = load_registry()
+        violations: List[Violation] = []
+        for path in ctx.iter_py(*SCAN_DIRS):
+            relpath = ctx.relpath(path)
+            if relpath.startswith("tools/lint/") \
+                    or relpath == "tools/check_excepts.py" \
+                    or relpath.startswith("tests/data/lint/"):
+                continue  # the linter and its fixtures mention metrics freely
+            violations.extend(
+                check_file(relpath, ctx.tree(path), registry))
+        return violations
+
+    def check_paths(self, ctx: LintContext, paths) -> List[Violation]:
+        registry = load_registry()
+        violations: List[Violation] = []
+        for path in paths:
+            violations.extend(
+                check_file(ctx.relpath(path), ctx.tree(path), registry))
+        return violations

@@ -1,0 +1,237 @@
+"""Run-report CLI for mythril-tpu Perfetto traces.
+
+    python -m tools.traceview TRACE.json
+
+Reads a Chrome ``trace_event`` JSON written by the observe span tracer
+(``MYTHRIL_TPU_TRACE=out.json`` / ``analyze --trace-out``) and prints:
+
+* the run manifest (``otherData``: argv/backend/contract, drop counts);
+* per-phase wall-time rollups — spans grouped by category (the leading
+  dotted component of the span name: ``dispatch.flush`` -> ``dispatch``)
+  and by full name, with count/total/mean/max and percent of the traced
+  wall clock;
+* span coverage: the fraction of the trace's wall window covered by at
+  least one span (merged intervals, per thread, then worst/best) —
+  ISSUE 5's acceptance wants >= 90% of measured wall time inside spans;
+* device-flush occupancy and latency histograms (``dispatch.flush``
+  spans' ``occupancy`` arg + duration), mirroring
+  SolverStatistics.batch_metrics;
+* XLA compile accounting: every ``xla.compile`` span with its
+  clause-shape key and cost — the per-shape compile cliff that the pow2
+  bucketing exists to bound.
+
+Stdlib-only (json/argparse/math): usable on a workstation without jax.
+Exit codes: 0 on success, 2 when the file is missing or not a valid
+trace_event document.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+#: bar width for the text histograms
+_BAR = 40
+
+
+def load_trace(path: str) -> Tuple[List[dict], Dict[str, object]]:
+    """Parse a trace_event document: the JSON Object Format
+    ({"traceEvents": [...], ...}) or the bare JSON Array Format.
+    Raises ValueError on anything else."""
+    with open(path, "r", encoding="utf-8") as handle:
+        doc = json.load(handle)
+    if isinstance(doc, list):
+        events, other = doc, {}
+    elif isinstance(doc, dict) and isinstance(doc.get("traceEvents"), list):
+        events, other = doc["traceEvents"], dict(doc.get("otherData") or {})
+    else:
+        raise ValueError(
+            "not a trace_event document: expected a JSON array of events "
+            "or an object with a 'traceEvents' list")
+    for event in events:
+        if not isinstance(event, dict) or "ph" not in event:
+            raise ValueError("malformed trace event (no 'ph' field): "
+                             f"{event!r:.120}")
+    return events, other
+
+
+def _spans(events: List[dict]) -> List[dict]:
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def _fmt_us(us: float) -> str:
+    """Adaptive duration: us under 1ms, ms under 1s, else seconds."""
+    if us < 1_000:
+        return f"{us:.0f}us"
+    if us < 1_000_000:
+        return f"{us / 1_000:.1f}ms"
+    return f"{us / 1_000_000:.2f}s"
+
+
+def rollup(spans: List[dict], key) -> List[dict]:
+    """Aggregate spans by `key(event)`: count/total/mean/max, sorted by
+    total descending."""
+    groups: Dict[str, List[float]] = defaultdict(list)
+    for span in spans:
+        groups[key(span)].append(float(span.get("dur", 0.0)))
+    out = []
+    for name, durs in groups.items():
+        out.append({
+            "name": name, "count": len(durs), "total_us": sum(durs),
+            "mean_us": sum(durs) / len(durs), "max_us": max(durs),
+        })
+    out.sort(key=lambda row: -row["total_us"])
+    return out
+
+
+def merged_coverage(spans: List[dict]) -> Tuple[float, float]:
+    """(covered_us, wall_us): microseconds of the trace window covered by
+    at least one span on SOME thread (intervals merged across threads —
+    concurrent spans count once), and the window's full width."""
+    if not spans:
+        return 0.0, 0.0
+    intervals = sorted(
+        (float(s["ts"]), float(s["ts"]) + float(s.get("dur", 0.0)))
+        for s in spans)
+    covered = 0.0
+    cur_start, cur_end = intervals[0]
+    for start, end in intervals[1:]:
+        if start > cur_end:
+            covered += cur_end - cur_start
+            cur_start, cur_end = start, end
+        else:
+            cur_end = max(cur_end, end)
+    covered += cur_end - cur_start
+    wall = max(end for _, end in intervals) - min(
+        start for start, _ in intervals)
+    return covered, wall
+
+
+def text_histogram(values: List[float], n_bins: int = 8) -> List[str]:
+    """Fixed-width text histogram lines for a value list."""
+    if not values:
+        return ["  (no observations)"]
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return [f"  {lo:10.1f} |{'#' * _BAR}| {len(values)}"]
+    width = (hi - lo) / n_bins
+    counts = [0] * n_bins
+    for value in values:
+        slot = min(int((value - lo) / width), n_bins - 1)
+        counts[slot] += 1
+    peak = max(counts)
+    lines = []
+    for i, count in enumerate(counts):
+        left = lo + i * width
+        bar = "#" * max(1 if count else 0,
+                        int(round(count / peak * _BAR)))
+        lines.append(f"  {left:10.1f} |{bar:<{_BAR}}| {count}")
+    return lines
+
+
+def report(events: List[dict], other: Dict[str, object]) -> str:
+    lines: List[str] = []
+    spans = _spans(events)
+    instants = [e for e in events if e.get("ph") == "i"]
+
+    lines.append("== run manifest ==")
+    if other:
+        for key, value in sorted(other.items()):
+            lines.append(f"  {key}: {value}")
+    else:
+        lines.append("  (none recorded)")
+    lines.append(f"  span events: {len(spans)}, instant events: "
+                 f"{len(instants)}")
+
+    covered, wall = merged_coverage(spans)
+    lines.append("")
+    lines.append("== per-phase wall time ==")
+    if not spans:
+        lines.append("  (no spans)")
+    else:
+        lines.append(f"  traced wall window: {_fmt_us(wall)}, span "
+                     f"coverage: {covered / wall * 100 if wall else 0:.1f}%")
+        for row in rollup(spans, lambda s: s.get("cat")
+                          or s["name"].split(".", 1)[0]):
+            share = row["total_us"] / wall * 100 if wall else 0.0
+            lines.append(
+                f"  [{share:5.1f}%] {row['name']:<12} "
+                f"total {_fmt_us(row['total_us']):>9}  "
+                f"x{row['count']:<6} mean {_fmt_us(row['mean_us']):>9}  "
+                f"max {_fmt_us(row['max_us']):>9}")
+        lines.append("")
+        lines.append("== per-span rollup ==")
+        for row in rollup(spans, lambda s: s["name"]):
+            share = row["total_us"] / wall * 100 if wall else 0.0
+            lines.append(
+                f"  [{share:5.1f}%] {row['name']:<26} "
+                f"total {_fmt_us(row['total_us']):>9}  "
+                f"x{row['count']:<6} mean {_fmt_us(row['mean_us']):>9}  "
+                f"max {_fmt_us(row['max_us']):>9}")
+
+    flushes = [s for s in spans if s["name"] == "dispatch.flush"]
+    lines.append("")
+    lines.append("== device flush (dispatch.flush) ==")
+    if flushes:
+        occupancies = [float(s.get("args", {}).get("occupancy", 0))
+                       for s in flushes]
+        lines.append(f"  flushes: {len(flushes)}, queries: "
+                     f"{sum(occupancies):.0f}, mean occupancy: "
+                     f"{sum(occupancies) / len(occupancies):.2f}/flush")
+        lines.append("  occupancy (queries/flush):")
+        lines.extend(text_histogram(occupancies))
+        lines.append("  latency (ms/flush):")
+        lines.extend(text_histogram(
+            [float(s.get("dur", 0.0)) / 1_000 for s in flushes]))
+    else:
+        lines.append("  (no flushes recorded)")
+
+    compiles = [s for s in spans if s["name"] == "xla.compile"]
+    lines.append("")
+    lines.append("== XLA compiles (per clause-shape bucket) ==")
+    if compiles:
+        total = sum(float(s.get("dur", 0.0)) for s in compiles)
+        lines.append(f"  {len(compiles)} first-call bucket(s), "
+                     f"{_fmt_us(total)} total compile-or-cache-load")
+        for span in sorted(compiles, key=lambda s: -float(s.get("dur", 0))):
+            shape = span.get("args", {}).get("shape", "?")
+            lines.append(f"  {_fmt_us(float(span.get('dur', 0.0))):>9}  "
+                         f"{shape}")
+    else:
+        lines.append("  (no xla.compile spans — every bucket was warm)")
+
+    if instants:
+        lines.append("")
+        lines.append("== instant events ==")
+        for event in instants:
+            args = event.get("args") or {}
+            detail = ", ".join(f"{k}={v}" for k, v in sorted(args.items()))
+            lines.append(f"  @{_fmt_us(float(event.get('ts', 0.0))):>9}  "
+                         f"{event['name']}" + (f"  ({detail})" if detail
+                                               else ""))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.traceview",
+        description="per-phase wall-time report for a mythril-tpu "
+                    "Perfetto trace")
+    parser.add_argument("trace", help="trace_event JSON written via "
+                        "MYTHRIL_TPU_TRACE / --trace-out / bench.py")
+    args = parser.parse_args(argv)
+    try:
+        events, other = load_trace(args.trace)
+    except (OSError, ValueError, json.JSONDecodeError) as error:
+        print(f"traceview: cannot read {args.trace}: {error}",
+              file=sys.stderr)
+        return 2
+    print(report(events, other))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
