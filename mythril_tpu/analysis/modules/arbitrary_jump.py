@@ -8,6 +8,7 @@ import logging
 
 from ...core.state.global_state import GlobalState
 from ...exceptions import UnsatError
+from ...smt.solver import cfa_screen
 from ...support.model import get_model
 from ..issue_annotation import attach_issue_annotation
 from ..module.base import DetectionModule, EntryPoint
@@ -28,6 +29,15 @@ class ArbitraryJump(DetectionModule):
     def _execute(self, state: GlobalState):
         jump_dest = state.mstate.stack[-1]
         if jump_dest.raw.is_const:
+            return []
+        # CFA-resolved site: the dataflow pinned every feasible target
+        # statically, so a <=1-target site is structurally not
+        # attacker-steerable — skip the two _is_unique_jumpdest solver
+        # queries it would otherwise take to prove that
+        targets = cfa_screen.resolved_jump_targets(
+            state.environment.code,
+            state.get_current_instruction()["address"])
+        if targets is not None and len(targets) <= 1:
             return []
         if self._is_unique_jumpdest(jump_dest, state):
             # symbolic but pinned to one feasible value: not attacker-steerable

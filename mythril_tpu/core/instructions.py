@@ -19,6 +19,7 @@ from typing import Callable, List, Optional, Tuple, Union
 
 from ..exceptions import UnsatError
 from ..ops.opcodes import OPCODES, GAS, STACK
+from ..smt.solver import cfa_screen
 from ..smt import (And, BitVec, Bool, Concat, Extract, If, LShR, Not, Or, SignExt,
                    UDiv, UGE, UGT, ULE, ULT, URem, SRem, SDiv, ZeroExt, simplify,
                    symbol_factory)
@@ -723,10 +724,18 @@ class Instruction:
         except TypeError:
             raise InvalidJumpDestination("symbolic JUMP destination")
         index = s.environment.code.index_of_address(jump_address)
-        if index is None:
-            raise InvalidJumpDestination(f"JUMP to missing address {jump_address}")
-        if s.environment.code.instruction_list[index].op_code != "JUMPDEST":
-            raise InvalidJumpDestination(f"JUMP to non-JUMPDEST {jump_address}")
+        # pre-solver screen: the CFA tables answer target validity
+        # statically (counted; a False verdict prunes before any
+        # constraint/solver work); None -> dynamic check as before
+        verdict = cfa_screen.screen_jump_target(s.environment.code, jump_address)
+        if verdict is None:
+            valid = (index is not None
+                     and s.environment.code.instruction_list[index].op_code
+                     == "JUMPDEST")
+        else:
+            valid = verdict and index is not None
+        if not valid:
+            raise InvalidJumpDestination(f"JUMP to invalid address {jump_address}")
         s.mstate.pc = index
         return [s]
 
@@ -753,8 +762,15 @@ class Instruction:
                 log.debug("skipping symbolic JUMPI destination")
                 return states
             index = s.environment.code.index_of_address(jump_address)
-            if (index is not None
-                    and s.environment.code.instruction_list[index].op_code == "JUMPDEST"):
+            verdict = cfa_screen.screen_jump_target(
+                s.environment.code, jump_address)
+            if verdict is None:
+                valid = (index is not None
+                         and s.environment.code.instruction_list[index].op_code
+                         == "JUMPDEST")
+            else:
+                valid = verdict and index is not None
+            if valid:
                 positive_state = copy(s)
                 positive_state.mstate.pc = index
                 positive_state.mstate.depth += 1  # depth = branches taken

@@ -11,6 +11,7 @@ import logging
 from typing import Dict, List, Set
 
 from ....exceptions import UnsatError
+from ....smt.solver import cfa_screen
 from ....support.model import get_model
 from ...state.annotation import StateAnnotation
 from ...state.global_state import GlobalState
@@ -116,10 +117,15 @@ class DependencyPruner(LaserPlugin):
                 return
             annotation = get_dependency_annotation(global_state)
             address = global_state.get_current_instruction()["address"]
-            if address in annotation.blocks_seen:
+            # key block bookkeeping by the CFA block (its start pc) rather
+            # than re-deriving basic blocks from raw JUMPDEST addresses;
+            # block_key falls back to the raw address when the cfa is off,
+            # and a JUMPDEST is its own block leader either way
+            block = cfa_screen.block_key(global_state.environment.code, address)
+            if block in annotation.blocks_seen:
                 return
-            annotation.blocks_seen.add(address)
-            annotation.path.append(address)
+            annotation.blocks_seen.add(block)
+            annotation.path.append(block)
 
         @symbolic_vm.laser_hook("add_world_state")
         def world_state_hook(global_state: GlobalState):

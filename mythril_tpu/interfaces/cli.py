@@ -71,6 +71,11 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
                               "tune via MYTHRIL_TPU_BATCH_FLUSH / "
                               "MYTHRIL_TPU_BATCH_AGE_MS / "
                               "MYTHRIL_TPU_VERDICT_CACHE")
+    options.add_argument("--no-cfa", action="store_true",
+                         help="disable the static control-flow-analysis "
+                              "screen (staticanalysis/): jump validity, "
+                              "merge-point tagging, and dead-code pruning "
+                              "fall back to dynamic checks (A/B measurement)")
     options.add_argument("--engine", default="host", choices=["host", "tpu"],
                          help="exploration engine: host worklist or the "
                               "batched TPU symbolic frontier")

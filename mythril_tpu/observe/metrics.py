@@ -125,6 +125,30 @@ _METRICS: List[MetricSpec] = [
                "Crash-safe checkpoint writes (host pickle + device npz)."),
     MetricSpec("checkpoint.write_ms", HISTOGRAM, "ms",
                "Wall time of one checkpoint write."),
+    # -- static control-flow analysis (mythril_tpu/staticanalysis/) --------------
+    MetricSpec("cfa.blocks", COUNTER, "1",
+               "Basic blocks recovered by cfa builds."),
+    MetricSpec("cfa.jumps_resolved", COUNTER, "1",
+               "Jump sites whose targets the cfa dataflow pinned."),
+    MetricSpec("cfa.jumps_unresolved", COUNTER, "1",
+               "Jump sites left with conservative fan-out edges."),
+    MetricSpec("cfa.merge_points", COUNTER, "1",
+               "Post-dominator merge points found at branch sites."),
+    MetricSpec("cfa.dead_bytes", COUNTER, "bytes",
+               "Code bytes proven statically unreachable."),
+    MetricSpec("cfa.screen.answered", COUNTER, "1",
+               "Jump-validity queries answered from the CFA tables "
+               "instead of dynamic instruction-list checks."),
+    MetricSpec("cfa.screen.infeasible", COUNTER, "1",
+               "Jump targets the screen proved invalid, pruning the "
+               "branch before any solver work."),
+    MetricSpec("cfa.frontier.merge_tagged", COUNTER, "1",
+               "Materialized device lanes tagged with the merge pc "
+               "their block reconverges at (groundwork for on-device "
+               "state merging)."),
+    MetricSpec("cfa.frontier.prefetch_skipped", COUNTER, "1",
+               "Feasibility prefetches skipped for statically dead or "
+               "invalid target pcs."),
     # -- engine plugins (core/plugin/plugins/) -----------------------------------
     MetricSpec("profiler.instruction_us", HISTOGRAM, "us",
                "Per-opcode host-engine instruction latency "

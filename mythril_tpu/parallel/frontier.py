@@ -42,8 +42,10 @@ from typing import Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.state.annotation import StateAnnotation
 from ..core.state.global_state import GlobalState
 from ..exceptions import UnsatError
+from ..smt.solver import cfa_screen
 from ..observe import metrics, trace
 from ..smt import Bool, Extract, symbol_factory
 from ..smt import terms as T
@@ -292,6 +294,20 @@ class LaneContext(A.TxContext):
         self.template = template
 
 
+class MergeTagAnnotation(StateAnnotation):
+    """Rides on materialized lanes whose basic block reconverges at a
+    static post-dominator pc: the merge pass of ROADMAP item 3 groups
+    lanes by this key (pc, not index, so it survives re-disassembly)."""
+
+    __slots__ = ("merge_pc",)
+
+    def __init__(self, merge_pc: int):
+        self.merge_pc = merge_pc
+
+    def __copy__(self):
+        return MergeTagAnnotation(self.merge_pc)
+
+
 def _storage_entries(storage) -> Tuple[List[Tuple[int, object]], bool]:
     """Walk the storage store-chain into ((concrete_key, BitVec_value) pairs,
     base_is_symbolic) — latest store wins. A symbolic BASE (every
@@ -460,6 +476,9 @@ class _Frontier:
             tx, _ = template.transaction_stack[-1]
             ctx = LaneContext(str(tx.id), template.environment.calldata,
                               template.environment, template)
+            # build the CFA tables now, outside the step loop: every
+            # materialized lane of this contract reads them
+            cfa_screen.warm(template.environment.code)
             self.contexts.append(ctx)
             ctx_id[lane] = len(self.contexts) - 1
             # symbolic storage values ride in as host-term leaves
@@ -701,7 +720,8 @@ class _Frontier:
                         entry = self.deferred[0]
                         rows_state, rows_planes, count, _ = entry
                         self._prefetch_feasibility(rows_planes,
-                                                   range(entry[3], count))
+                                                   range(entry[3], count),
+                                                   state_np=rows_state)
                         while entry[3] < count:
                             # advance the cursor in place BEFORE popping: a
                             # mid-loop exception must leave the entry (with
@@ -993,7 +1013,8 @@ class _Frontier:
                 rows_state, rows_planes, count, cursor = entry
                 take = min(count - cursor, batch_rows - fed)
                 self._prefetch_feasibility(rows_planes,
-                                           range(cursor, cursor + take))
+                                           range(cursor, cursor + take),
+                                           state_np=rows_state)
                 for row in range(cursor, cursor + take):
                     self._materialize_np(rows_state, rows_planes,
                                          self.harena, row)
@@ -1132,14 +1153,18 @@ class _Frontier:
             bools.append(cached)
         return bools
 
-    def _prefetch_feasibility(self, planes_np, rows) -> None:
+    def _prefetch_feasibility(self, planes_np, rows, state_np=None) -> None:
         """Escape-time pruning prefetch (MYTHRIL_TPU_CHECK_ESCAPES=1 +
         `--solver jax`): queue the feasibility queries of a whole slab of
         deferred rows on the solver's batch dispatch queue before
         _materialize_np walks them one at a time — the first row's
         _feasible() then flushes the slab as ONE device batch instead of
         paying a launch per lane. Best-effort: any trouble here just means
-        the rows solve individually, exactly as before."""
+        the rows solve individually, exactly as before.
+
+        When the caller threads `state_np` in, rows parked on a
+        statically-dead pc (CFA dead-code mask) are skipped: their
+        feasibility query is wasted solver work by construction."""
         if not self.check_escapes:
             return
         from ..core.state.constraints import Constraints
@@ -1150,6 +1175,11 @@ class _Frontier:
             if int(planes_np["cond_count"][row]) <= 0:
                 continue
             ctx = self.contexts[int(planes_np["ctx_id"][row])]
+            if state_np is not None and cfa_screen.statically_dead(
+                    ctx.template.environment.code,
+                    int(state_np["pc"][row])):
+                metrics.inc("cfa.frontier.prefetch_skipped")
+                continue
             constraints = Constraints(
                 list(ctx.template.world_state.constraints)
                 + self._cond_bools(planes_np, self.harena, row))
@@ -1293,6 +1323,14 @@ class _Frontier:
             from ..analysis.modules.exceptions import LastJumpAnnotation
 
             global_state.annotate(LastJumpAnnotation(last_jump))
+
+        # CFA merge tagging: lanes whose block reconverges at a static
+        # post-dominator pc carry the merge key, so the on-device merge
+        # pass (ROADMAP item 3) can group them without re-deriving the CFG
+        merge_pc = cfa_screen.merge_point_at(disassembly, byte_pc)
+        if merge_pc is not None:
+            global_state.annotate(MergeTagAnnotation(merge_pc))
+            metrics.inc("cfa.frontier.merge_tagged")
 
         # gas accounting (device tracks the lower-bound model)
         gas_used = int(state_np["gas_used"][lane])

@@ -1,0 +1,120 @@
+"""Pre-solver screen backed by the static CFA tables.
+
+The host engine decides jump-target validity dynamically on every
+JUMP/JUMPI execution (``index_of_address`` + opcode check), and several
+modules re-derive target sets per state. The CFA pass already knows the
+answers per *contract*: this module is the thin, counted adapter between
+the two worlds — consumers call it with a Disassembly + pc and get
+either a static verdict (counted in ``cfa.screen.*``) or None, in which
+case they keep their dynamic path.
+
+Soundness: CFA reachability over-approximates real reachability, so
+every concretely-reachable JUMPDEST is in the refined bitmap and screen
+verdicts coincide with the dynamic check — `--no-cfa` vs default produce
+identical detection results by construction. The only divergence is
+*work*: invalid/dead targets are dropped before any constraint is built
+or solver query issued (``cfa.screen.infeasible``).
+
+Everything funnels through :func:`enabled` so ``--no-cfa`` (the
+``args.cfa`` singleton field) and the MYTHRIL_TPU_CFA knob both gate the
+whole surface for A/B runs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ...observe import metrics
+from ...staticanalysis import CfaResult, get_cfa
+from ...support import tpu_config
+from ...support.support_args import args
+
+__all__ = [
+    "enabled",
+    "cfa_for",
+    "screen_jump_target",
+    "resolved_jump_targets",
+    "merge_point_at",
+    "statically_dead",
+    "block_key",
+    "warm",
+]
+
+
+def enabled() -> bool:
+    """The screen is live: neither --no-cfa nor MYTHRIL_TPU_CFA=0."""
+    return bool(getattr(args, "cfa", True)) \
+        and tpu_config.get_flag("MYTHRIL_TPU_CFA")
+
+
+def cfa_for(disassembly) -> Optional[CfaResult]:
+    """The (memoized) CFA tables for a contract, or None when the screen
+    is off or the pass bailed."""
+    if disassembly is None or not enabled():
+        return None
+    return get_cfa(disassembly)
+
+
+def warm(disassembly) -> None:
+    """Build the tables eagerly (e.g. at frontier seed time) so the
+    first screened jump doesn't pay the build inside the step loop."""
+    cfa_for(disassembly)
+
+
+def screen_jump_target(disassembly, jump_address: int) -> Optional[bool]:
+    """Static validity verdict for a concrete jump target.
+
+    True  -> `jump_address` is a statically-reachable JUMPDEST;
+    False -> provably not a valid target (prune before the solver);
+    None  -> no verdict (screen off, pass bailed, address out of range).
+
+    Every non-None answer is counted (``cfa.screen.answered``); False
+    answers additionally count ``cfa.screen.infeasible``.
+    """
+    result = cfa_for(disassembly)
+    if result is None:
+        return None
+    if not 0 <= jump_address < result.code_length:
+        return None  # out-of-range: leave to the dynamic path's error
+    verdict = result.is_valid_target(jump_address)
+    metrics.inc("cfa.screen.answered")
+    if not verdict:
+        metrics.inc("cfa.screen.infeasible")
+    return verdict
+
+
+def resolved_jump_targets(disassembly,
+                          site_pc: int) -> Optional[Tuple[int, ...]]:
+    """Statically-resolved target pcs of the jump site at `site_pc`;
+    () when the site provably throws; None when unresolved/unscreened."""
+    result = cfa_for(disassembly)
+    if result is None:
+        return None
+    return result.resolved_targets(site_pc)
+
+
+def merge_point_at(disassembly, pc: int) -> Optional[int]:
+    """The post-dominator merge pc the block containing `pc` flows into,
+    or None (no merge / no verdict)."""
+    result = cfa_for(disassembly)
+    if result is None:
+        return None
+    return result.merge_pc_at(pc)
+
+
+def statically_dead(disassembly, pc: int) -> bool:
+    """True only when `pc` is PROVEN unreachable (False = no claim)."""
+    result = cfa_for(disassembly)
+    return bool(result is not None and result.is_dead(pc))
+
+
+def block_key(disassembly, pc: int) -> int:
+    """Stable basic-block key for `pc` — the block's start pc, so
+    per-block bookkeeping (dependency pruner) keys one entry per block
+    instead of re-deriving JUMPDEST sets. Falls back to `pc` itself when
+    there is no verdict."""
+    result = cfa_for(disassembly)
+    if result is None:
+        return pc
+    block = result.block_at(pc)
+    return result.blocks[block].start_pc if block is not None else pc

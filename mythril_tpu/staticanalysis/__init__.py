@@ -1,0 +1,73 @@
+"""Static control-flow analysis (cfa) of EVM bytecode.
+
+Stdlib-only: block recovery, jump-target resolution via abstract
+stack/constant dataflow, CFG + dominator/post-dominator trees, and the
+dense device-consumable tables (pc->block, merge-pc, refined JUMPDEST
+bitmap, dead-code mask) that frontier pruning and on-device state
+merging (ROADMAP item 3) consume.
+
+Entry point for consumers: :func:`get_cfa` — memoized per Disassembly,
+returns None when analysis is disabled or bails (over the block budget),
+in which case callers keep their dynamic paths.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .cfa import BasicBlock, CfaResult, TERMINATORS, build_cfa
+from .domtree import compute_idoms, dominator_depth, postorder
+
+__all__ = [
+    "BasicBlock",
+    "CfaResult",
+    "TERMINATORS",
+    "build_cfa",
+    "compute_idoms",
+    "dominator_depth",
+    "postorder",
+    "get_cfa",
+]
+
+_MISS = object()  # memo sentinel: distinguishes "not built" from "bailed"
+
+
+def get_cfa(disassembly) -> Optional[CfaResult]:
+    """Build (once) and return the CFA tables for a Disassembly.
+
+    Memoized on the Disassembly instance itself (`_cfa_result`), so every
+    consumer of the same contract shares one build. Returns None when the
+    pass is disabled via MYTHRIL_TPU_CFA or bailed out; the None verdict
+    is memoized too, so a bailing contract pays the bail check once.
+    """
+    from ..observe import metrics, trace
+    from ..support import tpu_config
+
+    cached = getattr(disassembly, "_cfa_result", _MISS)
+    if cached is not _MISS:
+        return cached
+
+    if not tpu_config.get_flag("MYTHRIL_TPU_CFA"):
+        disassembly._cfa_result = None
+        return None
+
+    with trace.span("cfa.build") as span:
+        result = build_cfa(disassembly)
+        if result is None:
+            span.set(bailed=True)
+        else:
+            span.set(
+                blocks=len(result.blocks),
+                edges=result.n_edges,
+                resolved=len(result.jump_targets),
+                unresolved=len(result.unresolved_jumps),
+                merge_points=len(result.merge_points),
+            )
+            metrics.inc("cfa.blocks", len(result.blocks))
+            metrics.inc("cfa.jumps_resolved", len(result.jump_targets))
+            metrics.inc("cfa.jumps_unresolved",
+                        len(result.unresolved_jumps))
+            metrics.inc("cfa.merge_points", len(result.merge_points))
+            metrics.inc("cfa.dead_bytes", result.dead_bytes)
+    disassembly._cfa_result = result
+    return result

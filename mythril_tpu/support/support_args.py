@@ -44,6 +44,10 @@ class Args:
         #: cache + deferred-flush query batching on the jax lane;
         #: --no-batch-solve turns it off for A/B measurement
         self.batch_solve = True
+        #: static control-flow-analysis screen (staticanalysis/ +
+        #: smt/solver/cfa_screen.py); --no-cfa turns all consumers off
+        #: for A/B measurement
+        self.cfa = True
         self.sparse_pruning = True
         self.enable_state_merging = False
         self.enable_summaries = False

@@ -102,6 +102,18 @@ _KNOBS: List[Knob] = [
     Knob("MYTHRIL_TPU_TRACE_BUFFER", "int", 65536,
          "Span-tracer ring-buffer capacity in events; beyond it the "
          "oldest events drop (counted in the export)."),
+    # -- static control-flow analysis (mythril_tpu/staticanalysis/) ---------------
+    Knob("MYTHRIL_TPU_CFA", "flag", True,
+         "Build static CFA tables (CFG, post-dominator merge points, "
+         "refined JUMPDEST bitmap) per contract and let consumers answer "
+         "jump-validity queries from them; the --no-cfa CLI flag also "
+         "turns the consumers off for A/B runs."),
+    Knob("MYTHRIL_TPU_CFA_MAX_BLOCKS", "int", 16384,
+         "Basic-block budget above which the cfa pass bails out and "
+         "consumers keep their dynamic paths."),
+    Knob("MYTHRIL_TPU_CFA_STACK_DEPTH", "int", 32,
+         "Abstract-stack slots tracked per block entry by the cfa "
+         "constant dataflow; deeper slots are treated as unknown."),
     # -- test corpora -------------------------------------------------------------
     Knob("MYTHRIL_TPU_VMTESTS", "str", None,
          "Root of the ethereum/tests VMTests corpus for parity suites."),

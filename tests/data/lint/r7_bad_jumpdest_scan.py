@@ -1,0 +1,18 @@
+"""R7 fixture: re-implements jump-target resolution three ways."""
+
+
+class FakeDisassembly:
+    def __init__(self, instruction_list):
+        # (1) assignment to the canonical set name
+        self.valid_jump_destinations = {
+            ins.address for ins in instruction_list
+            if ins.op_code == "JUMPDEST"}  # (2) comprehension scan too
+
+
+def collect_targets(instruction_list):
+    # (3) longhand for-loop collection
+    targets = set()
+    for ins in instruction_list:
+        if ins.op_code == "JUMPDEST":
+            targets.add(ins.address)
+    return targets

@@ -1,0 +1,18 @@
+"""R7 fixture: consumes the CFA tables; point checks stay legal."""
+
+
+def is_jumpdest(instruction) -> bool:
+    # a point check on ONE instruction is not set construction
+    return instruction.op_code == "JUMPDEST"
+
+
+def screen(disassembly, code, jump_address):
+    from mythril_tpu.smt.solver import cfa_screen
+
+    # the blessed path: read the shared tables
+    verdict = cfa_screen.screen_jump_target(code, jump_address)
+    if verdict is None:
+        index = disassembly.index_of_address(jump_address)
+        return index is not None and \
+            disassembly.instruction_list[index].op_code == "JUMPDEST"
+    return verdict

@@ -1,0 +1,505 @@
+"""Static control-flow analysis tests (mythril_tpu/staticanalysis/).
+
+Host/AST-only except the one A/B parity case (a mini-killbilly symbolic
+run with the screen on vs off): synthetic bytecode CFGs, the post-
+dominator tree against a brute-force set-intersection reference on
+random small graphs, table-shape invariants, the cfa_screen consumer
+surface, the cfaview CLI, and a corpus smoke (vendored headline
+contracts when the reference corpus is not mounted)."""
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from mythril_tpu.frontends.asm import assemble, dispatcher
+from mythril_tpu.frontends.disassembler import Disassembly
+from mythril_tpu.observe import metrics
+from mythril_tpu.smt.solver import cfa_screen
+from mythril_tpu.staticanalysis import (build_cfa, compute_idoms, get_cfa,
+                                        postorder)
+from mythril_tpu.support.support_args import args
+
+REFERENCE_CORPUS = "/root/reference/solidity_examples"
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    metrics.reset()
+    saved = getattr(args, "cfa", True)
+    yield
+    args.cfa = saved
+    metrics.reset()
+
+
+def _cfa(source: str):
+    result = build_cfa(Disassembly(assemble(source).hex()))
+    assert result is not None
+    return result
+
+
+# -- synthetic bytecode cases --------------------------------------------------------
+
+
+DIAMOND = """
+PUSH1 0x00
+CALLDATALOAD
+PUSH @then
+JUMPI
+PUSH1 0x01
+PUSH @end
+JUMP
+then:
+JUMPDEST
+PUSH1 0x02
+end:
+JUMPDEST
+POP
+STOP
+"""
+
+
+def test_diamond_merge_point():
+    result = _cfa(DIAMOND)
+    assert result.fully_resolved
+    # both arms reconverge at the end: JUMPDEST — exactly one merge point
+    [merge_pc] = result.merge_points
+    assert result.valid_target_bitmap[merge_pc] == 1
+    # the branch site maps to it, and both arms' blocks report it
+    assert set(result.branch_merge_pc.values()) == {merge_pc}
+    for site, targets in result.jump_targets.items():
+        assert all(t in result.valid_targets for t in targets)
+
+
+def test_loop_resolves_backedge():
+    result = _cfa("""
+PUSH1 0x05
+head:
+JUMPDEST
+PUSH1 0x01
+SWAP1
+SUB
+DUP1
+PUSH @head
+JUMPI
+POP
+STOP
+""")
+    assert result.fully_resolved
+    # the JUMPI's taken edge is the backedge to head:
+    [(site, targets)] = list(result.jump_targets.items())
+    assert len(targets) == 1
+    assert targets[0] < site  # jumps backwards
+    assert targets[0] in result.valid_targets
+
+
+def test_dead_code_past_unconditional_jump():
+    result = _cfa("""
+PUSH @end
+JUMP
+PUSH1 0xFF
+PUSH1 0xEE
+POP
+POP
+end:
+JUMPDEST
+STOP
+""")
+    assert result.fully_resolved
+    [(_, (target,))] = list(result.jump_targets.items())
+    # everything between the JUMP and the landing JUMPDEST is dead
+    jump_end = 4  # PUSH2 (3 bytes) + JUMP
+    assert all(result.dead_mask[pc] for pc in range(jump_end, target))
+    assert result.dead_bytes == target - jump_end
+    assert not result.is_dead(target)
+    assert not any(result.dead_mask[:jump_end])
+
+
+def test_unresolvable_dynamic_jump_fans_out():
+    result = _cfa("""
+PUSH1 0x00
+CALLDATALOAD
+JUMP
+a:
+JUMPDEST
+STOP
+b:
+JUMPDEST
+STOP
+""")
+    assert not result.fully_resolved
+    [site] = result.unresolved_jumps
+    assert result.resolved_targets(site) is None
+    # conservative fan-out: every JUMPDEST stays reachable + valid
+    assert len(result.valid_targets) == 2
+    assert result.dead_bytes == 0
+
+
+def test_constant_flows_through_dup_swap_and_mask():
+    # target survives DUP/SWAP shuffling and an AND mask (solc idiom)
+    result = _cfa("""
+PUSH2 0x0FFF
+PUSH @end
+AND
+PUSH1 0x2a
+SWAP1
+JUMP
+end:
+JUMPDEST
+POP
+STOP
+""")
+    assert result.fully_resolved
+    [(_, targets)] = list(result.jump_targets.items())
+    assert len(targets) == 1
+    assert targets[0] in result.valid_targets
+
+
+def test_constant_invalid_target_is_provable_throw():
+    # jumps into the middle of a PUSH immediate: no JUMPDEST there
+    result = _cfa("PUSH1 0x01\nJUMP\nJUMPDEST\nSTOP")
+    [(site, targets)] = list(result.jump_targets.items())
+    assert targets == ()  # provably throws
+
+
+def test_pc_opcode_is_a_known_constant():
+    result = _cfa("""
+PC
+PUSH1 0x03
+ADD
+JUMP
+JUMPDEST
+STOP
+""")
+    # PC pushes 0; 0 + 4... the JUMPDEST sits right after JUMP at pc 4
+    assert result.fully_resolved
+
+
+def test_bail_over_block_budget():
+    source = "\n".join(["JUMPDEST"] * 40) + "\nSTOP"
+    dis = Disassembly(assemble(source).hex())
+    assert build_cfa(dis, max_blocks=8) is None
+    assert build_cfa(dis) is not None
+
+
+# -- dense-table invariants ----------------------------------------------------------
+
+
+def test_table_shapes_and_memoization():
+    dis = Disassembly(assemble(DIAMOND).hex())
+    result = get_cfa(dis)
+    assert result is get_cfa(dis)  # memoized on the instance
+    n = result.code_length
+    assert len(result.pc_to_block) == n
+    assert len(result.valid_target_bitmap) == n
+    assert len(result.dead_mask) == n
+    assert len(result.block_merge_pc) == len(result.blocks)
+    assert result.exit_id == len(result.blocks)
+    # every byte of a block maps back to it; immediates inherit the block
+    for block in result.blocks:
+        for pc in range(block.start_pc, block.end_pc):
+            assert result.pc_to_block[pc] == block.block_id
+    # bitmap agrees with the set form
+    assert {pc for pc, bit in enumerate(result.valid_target_bitmap)
+            if bit} == result.valid_targets
+    # refined bitmap is a subset of the disassembler's unrefined one
+    assert result.valid_targets <= dis.valid_jump_destinations
+
+
+def test_metrics_emitted_on_build():
+    get_cfa(Disassembly(assemble(DIAMOND).hex()))
+    snapshot = metrics.snapshot()
+    assert snapshot["cfa.blocks"] > 0
+    assert snapshot["cfa.jumps_resolved"] == 2
+    assert snapshot["cfa.merge_points"] == 1
+
+
+# -- post-dominators vs a brute-force reference --------------------------------------
+
+
+def _dom_sets(succs, entry):
+    """Reference: iterative full dominator *sets* to a fixed point, over
+    the reachable subgraph only (unreachable preds contribute nothing)."""
+    reachable = set(postorder(succs, entry))
+    preds = {node: [] for node in reachable}
+    for node in reachable:
+        for nxt in succs[node]:
+            if nxt in reachable:
+                preds[nxt].append(node)
+    dom = {node: set(reachable) for node in reachable}
+    dom[entry] = {entry}
+    changed = True
+    while changed:
+        changed = False
+        for node in reachable:
+            if node == entry:
+                continue
+            new = set(reachable)
+            for pred in preds[node]:
+                new &= dom[pred]
+            new |= {node}
+            if new != dom[node]:
+                dom[node] = new
+                changed = True
+    return dom, reachable
+
+
+def _idom_from_sets(dom, reachable, entry, n):
+    """Unique strict dominator dominated by all other strict dominators."""
+    idom = [None] * n
+    idom[entry] = entry
+    for node in reachable:
+        if node == entry:
+            continue
+        strict = (dom[node] - {node}) & reachable
+        for cand in strict:
+            # the immediate dominator is the LOWEST strict dominator:
+            # every other strict dominator of `node` dominates it
+            if all(other in dom[cand] for other in strict):
+                idom[node] = cand
+                break
+    return idom
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_idoms_match_brute_force_on_random_graphs(seed):
+    rng = random.Random(seed)
+    n = rng.randint(4, 12)
+    succs = [[] for _ in range(n)]
+    for node in range(n):
+        for _ in range(rng.randint(0, 3)):
+            succs[node].append(rng.randrange(n))
+    fast = compute_idoms(succs, entry=0)
+    dom, reachable = _dom_sets(succs, entry=0)
+    ref = _idom_from_sets(dom, reachable, entry=0, n=n)
+    for node in range(n):
+        if node in reachable:
+            assert fast[node] == ref[node], (seed, node, succs)
+        else:
+            assert fast[node] is None
+
+
+def test_postdom_is_idom_on_reversed_diamond():
+    #   0 -> 1 -> 3, 0 -> 2 -> 3, 3 -> 4(exit)
+    succs = [[1, 2], [3], [3], [4], []]
+    reverse = [[] for _ in succs]
+    for node, nexts in enumerate(succs):
+        for nxt in nexts:
+            reverse[nxt].append(node)
+    ipostdom = compute_idoms(reverse, entry=4)
+    assert ipostdom[0] == 3  # the branch post-dominates at the join
+    assert ipostdom[1] == 3 and ipostdom[2] == 3
+    assert ipostdom[3] == 4
+
+
+# -- the cfa_screen consumer surface -------------------------------------------------
+
+
+def test_screen_verdicts_and_counters():
+    dis = Disassembly(assemble(DIAMOND).hex())
+    result = get_cfa(dis)
+    [merge_pc] = result.merge_points
+    assert cfa_screen.screen_jump_target(dis, merge_pc) is True
+    assert cfa_screen.screen_jump_target(dis, 0) is False  # not a JUMPDEST
+    assert cfa_screen.screen_jump_target(dis, 10_000) is None  # out of range
+    snapshot = metrics.snapshot()
+    assert snapshot["cfa.screen.answered"] == 2
+    assert snapshot["cfa.screen.infeasible"] == 1
+
+
+def test_screen_agrees_with_dynamic_check_everywhere():
+    """Soundness/parity: on a fully-resolved contract the screen verdict
+    equals the dynamic index_of_address + JUMPDEST check for EVERY
+    in-range address — the A/B-identical-results argument, exhaustively."""
+    for source in (DIAMOND, dispatcher({"f()": "JUMPDEST\nSTOP"})):
+        dis = Disassembly(assemble(source).hex())
+        result = get_cfa(dis)
+        assert result.fully_resolved
+        for pc in range(result.code_length):
+            dynamic = (dis.index_of_address(pc) is not None
+                       and dis.instruction_list[
+                           dis.index_of_address(pc)].op_code == "JUMPDEST")
+            static = cfa_screen.screen_jump_target(dis, pc)
+            if dynamic:
+                assert static is True, pc
+            else:
+                assert static in (False, None), pc
+
+
+def test_no_cfa_flag_disables_every_verdict():
+    dis = Disassembly(assemble(DIAMOND).hex())
+    args.cfa = False
+    assert not cfa_screen.enabled()
+    assert cfa_screen.screen_jump_target(dis, 0) is None
+    assert cfa_screen.resolved_jump_targets(dis, 0) is None
+    assert cfa_screen.merge_point_at(dis, 0) is None
+    assert not cfa_screen.statically_dead(dis, 0)
+    assert cfa_screen.block_key(dis, 7) == 7  # raw-pc fallback
+    assert "cfa.screen.answered" not in metrics.snapshot()
+
+
+def test_knob_disables_the_pass(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_CFA", "0")
+    dis = Disassembly(assemble(DIAMOND).hex())
+    assert get_cfa(dis) is None
+
+
+def test_block_key_maps_into_block_start():
+    dis = Disassembly(assemble(DIAMOND).hex())
+    result = get_cfa(dis)
+    for block in result.blocks:
+        if block.block_id in result.reachable:
+            assert cfa_screen.block_key(dis, block.start_pc) \
+                == block.start_pc
+
+
+# -- A/B parity: screen on vs off, identical detections ------------------------------
+
+
+def _analyze_killbilly():
+    from mythril_tpu.analysis.security import (fire_lasers,
+                                               reset_callback_modules)
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.frontends.asm import creation_wrapper
+
+    contract = {
+        "activatekillability()": "PUSH1 0x01\nPUSH1 0x00\nSSTORE\nSTOP",
+        "commencekilling()":
+            "PUSH1 0x00\nSLOAD\nPUSH1 0x01\nEQ\nPUSH @do_kill\nJUMPI\nSTOP\n"
+            "do_kill:\nJUMPDEST\nCALLER\nSELFDESTRUCT",
+    }
+    reset_callback_modules()
+    creation = creation_wrapper(assemble(dispatcher(contract)))
+    wrapper = SymExecWrapper(
+        creation.hex(), address=None, strategy="bfs", max_depth=128,
+        execution_timeout=60, create_timeout=20, transaction_count=2,
+        modules=["AccidentallyKillable"], compulsory_statespace=False)
+    issues = fire_lasers(wrapper, white_list=["AccidentallyKillable"])
+    return sorted((issue.swc_id, issue.address) for issue in issues)
+
+
+def test_ab_parity_and_answered_counter():
+    args.cfa = True
+    with_cfa = _analyze_killbilly()
+    answered = metrics.snapshot().get("cfa.screen.answered", 0)
+    assert answered > 0  # the screen decided real jump queries
+    metrics.reset()
+    args.cfa = False
+    without_cfa = _analyze_killbilly()
+    assert metrics.snapshot().get("cfa.screen.answered", 0) == 0
+    assert with_cfa == without_cfa  # identical detections
+    assert with_cfa  # and the SWC-106 was actually found
+    assert with_cfa[0][0] == "106"
+
+
+@pytest.mark.slow
+def test_ab_parity_full_killbilly():
+    """The headline 3-tx killbilly (vendored), screen on vs off."""
+    from mythril_tpu.analysis.security import (fire_lasers,
+                                               reset_callback_modules)
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.frontends.asm import creation_wrapper
+    from tools.measure_headline import KILLBILLY
+
+    def run():
+        reset_callback_modules()
+        creation = creation_wrapper(assemble(dispatcher(KILLBILLY)))
+        wrapper = SymExecWrapper(
+            creation.hex(), address=None, strategy="bfs", max_depth=128,
+            execution_timeout=120, create_timeout=20, transaction_count=3,
+            modules=["AccidentallyKillable"], compulsory_statespace=False)
+        issues = fire_lasers(wrapper, white_list=["AccidentallyKillable"])
+        return sorted((issue.swc_id, issue.address) for issue in issues)
+
+    args.cfa = True
+    with_cfa = run()
+    assert metrics.snapshot().get("cfa.screen.answered", 0) > 0
+    metrics.reset()
+    args.cfa = False
+    without_cfa = run()
+    assert with_cfa == without_cfa
+
+
+# -- corpus smoke --------------------------------------------------------------------
+
+
+def _corpus_bytecodes():
+    """(name, hex) for every corpus contract whose bytecode is on disk;
+    vendored headline contracts when the reference tree is absent."""
+    out = []
+    names = sorted(json.load(
+        open(os.path.join(REPO_ROOT, "corpus_host.json")))["contracts"])
+    for name in names:
+        path = os.path.join(REFERENCE_CORPUS, name)
+        if os.path.exists(path):
+            with open(path) as handle:
+                out.append((name, handle.read().strip()))
+    if not out:
+        from tools.measure_headline import BECTOKEN, KILLBILLY
+
+        out = [(name, assemble(dispatcher(spec)).hex())
+               for name, spec in (("killbilly", KILLBILLY),
+                                  ("bectoken", BECTOKEN))]
+    return out
+
+
+def test_corpus_smoke_resolution_rate():
+    contracts = _corpus_bytecodes()
+    assert contracts
+    resolved = 0
+    for name, bytecode in contracts:
+        result = build_cfa(Disassembly(bytecode))
+        assert result is not None, name
+        assert result.n_jump_sites > 0, name
+        assert len(result.valid_targets) > 0, name
+        if result.fully_resolved:
+            resolved += 1
+    # the acceptance bar: cfa fully resolves >= 80% of the corpus
+    assert resolved / len(contracts) >= 0.8, (resolved, len(contracts))
+
+
+def test_cfaview_reports_corpus_contracts():
+    from tools import cfaview
+
+    for name, bytecode in _corpus_bytecodes():
+        dis = Disassembly(bytecode)
+        result = build_cfa(dis)
+        text = cfaview.report(result, dis.instruction_list)
+        assert "== merge points" in text, name
+        assert "== blocks ==" in text, name
+
+
+# -- cfaview CLI ---------------------------------------------------------------------
+
+
+def _cfaview(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.cfaview", *argv],
+        cwd=REPO_ROOT, capture_output=True, text=True)
+
+
+def test_cfaview_cli_on_vendored_contract():
+    proc = _cfaview("killbilly")
+    assert proc.returncode == 0, proc.stderr
+    assert "fully resolved" in proc.stdout
+    assert "== merge points" in proc.stdout
+
+
+def test_cfaview_cli_on_hex_string():
+    bytecode = assemble(DIAMOND).hex()
+    proc = _cfaview(bytecode)
+    assert proc.returncode == 0, proc.stderr
+    assert "merge points: 1" in proc.stdout
+
+
+def test_cfaview_cli_rejects_garbage():
+    proc = _cfaview("not-hex-not-a-file")
+    assert proc.returncode == 2
+    assert "cannot load" in proc.stderr

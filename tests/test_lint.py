@@ -29,10 +29,11 @@ if REPO_ROOT not in sys.path:
 from tools.lint import (Baseline, LintContext, LintRule,  # noqa: E402
                         RuleDiscovery, Violation, run_lint)
 from tools.lint.rules import (dispatch_bypass, env_knobs,  # noqa: E402
-                              metrics_registry, opcode_semantics,
-                              silent_excepts, trace_safety)
+                              jump_resolution, metrics_registry,
+                              opcode_semantics, silent_excepts,
+                              trace_safety)
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
 
 
 def _tree(text, filename="<fixture>"):
@@ -140,6 +141,10 @@ def _r6(name):
                                        metrics_registry.load_registry())
 
 
+def _r7(name):
+    return jump_resolution.check_file(name, _fixture_tree(name))
+
+
 @pytest.mark.parametrize("runner,fixture,expected_sites", [
     (_r1, "r1_bad_silent_pass.py", {"drain"}),
     (_r1, "r1_bad_bare_continue.py", {"poll", "<module>"}),
@@ -156,6 +161,8 @@ def _r6(name):
     (_r6, "r6_bad_undeclared.py",
      {"solver.warp_speed", "frontier.vibes", "dispatch.flux_capacitance"}),
     (_r6, "r6_bad_from_import.py", {"solver.queries_typo"}),
+    (_r7, "r7_bad_jumpdest_scan.py",
+     {"valid_jump_destinations", "comp:SetComp", "for-collect"}),
 ])
 def test_bad_fixture_fires(runner, fixture, expected_sites):
     violations = runner(fixture)
@@ -172,6 +179,7 @@ def test_bad_fixture_fires(runner, fixture, expected_sites):
     (_r4, "r4_clean.py"),
     (_r5, "r5_clean.py"),
     (_r6, "r6_clean.py"),
+    (_r7, "r7_clean.py"),
 ])
 def test_clean_fixture_is_quiet(runner, fixture):
     assert runner(fixture) == []

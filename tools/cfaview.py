@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Static-CFA report CLI for mythril-tpu.
+
+    python -m tools.cfaview CONTRACT
+
+CONTRACT is one of:
+
+* a path to a file holding hex runtime bytecode (``*.sol.o``, ``.hex``,
+  with or without a ``0x`` prefix / trailing whitespace);
+* a raw hex string (``0x6080...`` or bare);
+* a vendored contract name: ``killbilly`` or ``bectoken`` (the
+  hand-assembled headline contracts from tools/measure_headline.py).
+
+Prints the cfa verdict (mythril_tpu/staticanalysis/): summary counters,
+the basic-block table (pc range, terminator, successors, entry stack
+height, post-dominator merge pc), resolved/unresolved jump sites, branch
+merge points, and statically-dead code regions. ``--json`` dumps the
+raw tables instead.
+
+Host-only (the cfa pass is stdlib + in-repo frontends; no jax import).
+Exit codes: 0 on success, 2 when the input is missing/undecodable or the
+pass bails (block budget).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_VENDORED = ("killbilly", "bectoken")
+
+
+def _vendored_bytecode(name: str) -> str:
+    from mythril_tpu.frontends.asm import assemble, dispatcher
+    from tools.measure_headline import BECTOKEN, KILLBILLY
+
+    functions = KILLBILLY if name == "killbilly" else BECTOKEN
+    return assemble(dispatcher(functions)).hex()
+
+
+def load_bytecode(spec: str) -> str:
+    """Resolve CONTRACT to a hex bytecode string. Raises ValueError."""
+    if spec.lower() in _VENDORED:
+        return _vendored_bytecode(spec.lower())
+    if os.path.exists(spec):
+        with open(spec, "r", encoding="utf-8") as handle:
+            text = handle.read().strip()
+    else:
+        text = spec.strip()
+    if text.startswith(("0x", "0X")):
+        text = text[2:]
+    text = "".join(text.split())
+    if not text:
+        raise ValueError("empty bytecode")
+    int(text, 16)  # raises ValueError on non-hex
+    if len(text) % 2:
+        raise ValueError("odd-length hex string")
+    return text
+
+
+def _succ_str(block, result) -> str:
+    parts = []
+    for succ in sorted(block.successors):
+        parts.append("EXIT" if succ == result.exit_id
+                     else f"B{succ}@{result.blocks[succ].start_pc:#x}")
+    return ",".join(parts) if parts else "-"
+
+
+def _dead_regions(result) -> List[tuple]:
+    regions, start = [], None
+    for pc, dead in enumerate(result.dead_mask):
+        if dead and start is None:
+            start = pc
+        elif not dead and start is not None:
+            regions.append((start, pc))
+            start = None
+    if start is not None:
+        regions.append((start, len(result.dead_mask)))
+    return regions
+
+
+def report(result, instructions) -> str:
+    lines: List[str] = []
+    n_reach = len(result.reachable)
+    lines.append("== summary ==")
+    lines.append(f"  code: {result.code_length} bytes, "
+                 f"{len(instructions)} instructions")
+    lines.append(f"  blocks: {len(result.blocks)} "
+                 f"({n_reach} reachable), edges: {result.n_edges}")
+    lines.append(f"  jump sites: {result.n_jump_sites} "
+                 f"({len(result.jump_targets)} resolved, "
+                 f"{len(result.unresolved_jumps)} unresolved"
+                 + (", fully resolved)" if result.fully_resolved else ")"))
+    lines.append(f"  valid targets (reachable JUMPDESTs): "
+                 f"{len(result.valid_targets)}")
+    lines.append(f"  merge points: {len(result.merge_points)}, "
+                 f"dead code: {result.dead_bytes} bytes")
+
+    lines.append("")
+    lines.append("== blocks ==")
+    lines.append(f"  {'id':>4} {'pc range':>15} {'term':<10} {'h':>4} "
+                 f"{'merge':>7}  successors")
+    for block in result.blocks:
+        dead = block.block_id not in result.reachable
+        height = "?" if block.entry_height is None else block.entry_height
+        merge = result.block_merge_pc[block.block_id]
+        lines.append(
+            f"  {block.block_id:>4} "
+            f"{block.start_pc:#7x}..{block.end_pc:#6x} "
+            f"{(block.terminator or 'fall'):<10} {height:>4} "
+            f"{(f'{merge:#x}' if merge >= 0 else '-'):>7}  "
+            + ("DEAD" if dead else _succ_str(block, result)))
+
+    lines.append("")
+    lines.append("== jump sites ==")
+    if not result.jump_targets and not result.unresolved_jumps:
+        lines.append("  (none reachable)")
+    for site in sorted(result.jump_targets):
+        targets = result.jump_targets[site]
+        dest = ", ".join(f"{t:#x}" for t in targets) if targets \
+            else "(provably throws)"
+        lines.append(f"  {site:#6x} -> {dest}")
+    for site in sorted(result.unresolved_jumps):
+        lines.append(f"  {site:#6x} -> ?  (unresolved: conservative "
+                     f"fan-out to every JUMPDEST)")
+
+    lines.append("")
+    lines.append("== merge points (branch site -> postdom pc) ==")
+    if result.branch_merge_pc:
+        for site in sorted(result.branch_merge_pc):
+            lines.append(f"  {site:#6x} -> {result.branch_merge_pc[site]:#x}")
+    else:
+        lines.append("  (no branch reconverges before exit)")
+
+    regions = _dead_regions(result)
+    lines.append("")
+    lines.append("== statically dead code ==")
+    if regions:
+        for start, end in regions:
+            lines.append(f"  {start:#6x}..{end:#x}  ({end - start} bytes)")
+    else:
+        lines.append("  (none)")
+    return "\n".join(lines)
+
+
+def as_json(result) -> dict:
+    """The dense tables, JSON-serializable (dict keys become strings)."""
+    return {
+        "code_length": result.code_length,
+        "blocks": [
+            {"id": b.block_id, "start_pc": b.start_pc, "end_pc": b.end_pc,
+             "terminator": b.terminator, "entry_height": b.entry_height,
+             "successors": sorted(b.successors),
+             "reachable": b.block_id in result.reachable}
+            for b in result.blocks],
+        "exit_id": result.exit_id,
+        "n_edges": result.n_edges,
+        "pc_to_block": list(result.pc_to_block),
+        "block_merge_pc": list(result.block_merge_pc),
+        "branch_merge_pc": {str(pc): merge for pc, merge
+                            in sorted(result.branch_merge_pc.items())},
+        "valid_targets": sorted(result.valid_targets),
+        "jump_targets": {str(pc): list(targets) for pc, targets
+                         in sorted(result.jump_targets.items())},
+        "unresolved_jumps": sorted(result.unresolved_jumps),
+        "dead_mask": [int(dead) for dead in result.dead_mask],
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.cfaview",
+        description="static control-flow-analysis report for EVM "
+                    "runtime bytecode")
+    parser.add_argument("contract",
+                        help="hex bytecode file, raw hex string, or a "
+                             f"vendored name ({'/'.join(_VENDORED)})")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the raw cfa tables as JSON")
+    args = parser.parse_args(argv)
+    try:
+        bytecode = load_bytecode(args.contract)
+    except (OSError, ValueError) as error:
+        print(f"cfaview: cannot load {args.contract!r}: {error}",
+              file=sys.stderr)
+        return 2
+
+    from mythril_tpu.frontends.disassembler import Disassembly
+    from mythril_tpu.staticanalysis import build_cfa
+
+    disassembly = Disassembly(bytecode)
+    result = build_cfa(disassembly)
+    if result is None:
+        print("cfaview: cfa pass bailed (empty code or over the "
+              "MYTHRIL_TPU_CFA_MAX_BLOCKS budget)", file=sys.stderr)
+        return 2
+    if args.json:
+        import json
+        print(json.dumps(as_json(result), indent=2))
+    else:
+        print(report(result, disassembly.instruction_list))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# One-shot pre-merge gate: tpu-lint, then the tier-1 suite.
+#
+#     tools/check.sh            # lint + tier-1 (the ROADMAP "Tier-1 verify")
+#     tools/check.sh --lint     # lint only (fast pre-commit)
+#
+# Exits non-zero on the first failing stage. The tier-1 stage is the
+# exact command from ROADMAP.md (870 s budget, slow tests excluded) and
+# prints DOTS_PASSED= for the driver.
+
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tpu-lint =="
+python -m tools.lint || exit $?
+
+if [ "${1:-}" = "--lint" ]; then
+    exit 0
+fi
+
+echo
+echo "== tier-1 (pytest, not slow, 870s budget) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit $rc

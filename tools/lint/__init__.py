@@ -26,6 +26,10 @@ Rules (see ``tools/lint/rules/``):
 * **R6 metrics-registry** — every metric emitted through
   ``observe.metrics`` (``inc`` / ``set_gauge`` / ``observe``) must name a
   metric declared in ``mythril_tpu/observe/metrics.py``.
+* **R7 jump-resolution** — jump-target resolution (JUMPDEST set
+  construction, ``valid_jump_destinations``) belongs to
+  ``mythril_tpu/staticanalysis/``; consumers read the CFA tables through
+  ``smt/solver/cfa_screen.py``.
 
 Run ``python -m tools.lint`` (exit 1 on violations), or via the tier-1
 suite (tests/test_lint.py). Known, audited violations live in

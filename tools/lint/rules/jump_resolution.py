@@ -1,0 +1,168 @@
+"""R7 — jump-resolution ownership: the CFA tables are the single source
+of jump-target truth.
+
+``mythril_tpu/staticanalysis/`` resolves jump targets once per contract
+(reachability-refined JUMPDEST bitmap, per-site resolved target sets)
+and every consumer reads those tables through
+``smt/solver/cfa_screen.py``. A module that re-derives the target set —
+building its own JUMPDEST collection or a ``valid_jump_destinations``
+set — forks that truth: the copies drift the moment the cfa pass learns
+something (dead-code refinement, new dataflow), and the screen's A/B
+counters stop meaning anything.
+
+Flagged outside ``mythril_tpu/staticanalysis/``:
+
+* any assignment to a ``valid_jump_destinations`` name/attribute
+  (the literal re-implementation this rule exists for);
+* a set/list comprehension — or a generator fed straight into
+  ``set()``/``list()``/``frozenset()``/``sorted()``/``tuple()`` — whose
+  filter or element compares something to the string ``"JUMPDEST"``
+  (collection-building from a JUMPDEST scan; point checks like
+  ``op_code != "JUMPDEST"`` on one instruction, or ``next(...)``
+  lookups, are fine and not flagged);
+* a ``for`` loop whose body tests ``== "JUMPDEST"`` and then
+  ``.add(...)``/``.append(...)``s into a collection (the longhand of
+  the comprehension above).
+
+The one legitimate producer — ``frontends/disassembler.py``, which
+builds the *unrefined* bitmap the cfa pass itself starts from — carries
+a justified baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import LintContext, LintRule, Violation
+
+SCAN_DIRS = ("mythril_tpu", "tools", "tests", "bench.py")
+ALLOWED_PREFIX = "mythril_tpu/staticanalysis/"
+SET_NAME = "valid_jump_destinations"
+MARKER = "JUMPDEST"
+
+
+def _compares_jumpdest(node: ast.AST) -> bool:
+    """Any Compare under `node` with a "JUMPDEST" string operand."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Compare):
+            continue
+        operands = [sub.left] + list(sub.comparators)
+        for operand in operands:
+            if isinstance(operand, ast.Constant) \
+                    and operand.value == MARKER:
+                return True
+    return False
+
+
+def _comp_scans_jumpdest(node) -> bool:
+    """Comprehension/generator whose element or filters compare to
+    "JUMPDEST"."""
+    clauses = [node.elt] + [
+        cond for gen in node.generators for cond in gen.ifs]
+    return any(_compares_jumpdest(clause) for clause in clauses)
+
+
+def _target_names(node: ast.AST) -> List[str]:
+    """Plain/attribute names an assignment writes to."""
+    names = []
+    for target in ast.walk(node):
+        if isinstance(target, ast.Attribute):
+            names.append(target.attr)
+        elif isinstance(target, ast.Name):
+            names.append(target.id)
+    return names
+
+
+def check_file(relpath: str, tree: ast.AST) -> List[Violation]:
+    violations: List[Violation] = []
+
+    seen_tags: dict = {}
+
+    def flag(lineno: int, how: str, tag: str) -> None:
+        # stable, line-free keys: same-kind repeats get an ordinal suffix
+        # (walk order is deterministic for a given file)
+        ordinal = seen_tags.get(tag, 0)
+        seen_tags[tag] = ordinal + 1
+        if ordinal:
+            tag = f"{tag}#{ordinal}"
+        violations.append(Violation(
+            "R7", relpath, lineno,
+            f"{how} re-implements jump-target resolution — consume the "
+            "shared CFA tables instead (staticanalysis.get_cfa / "
+            "smt/solver/cfa_screen.py: is_valid_target, "
+            "resolved_jump_targets)",
+            where=tag, key=f"R7:{relpath}:{tag}"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for target in targets:
+                if SET_NAME in _target_names(target):
+                    flag(node.lineno,
+                         f"assignment to `{SET_NAME}`", SET_NAME)
+        elif isinstance(node, (ast.SetComp, ast.ListComp)):
+            if _comp_scans_jumpdest(node):
+                kind = type(node).__name__
+                flag(node.lineno,
+                     f"{kind} collecting instructions by "
+                     f'`== "{MARKER}"`', f"comp:{kind}")
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id in ("set", "list", "frozenset",
+                                     "sorted", "tuple"):
+            # a bare generator is often a point lookup (next(...)); it
+            # only builds a collection when fed to a constructor
+            for arg in node.args:
+                if isinstance(arg, ast.GeneratorExp) \
+                        and _comp_scans_jumpdest(arg):
+                    flag(node.lineno,
+                         f"{node.func.id}(generator) collecting "
+                         f'instructions by `== "{MARKER}"`',
+                         f"comp:{node.func.id}")
+        elif isinstance(node, ast.For):
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.If)
+                        and _compares_jumpdest(sub.test)):
+                    continue
+                for call in ast.walk(sub):
+                    if isinstance(call, ast.Call) \
+                            and isinstance(call.func, ast.Attribute) \
+                            and call.func.attr in ("add", "append"):
+                        flag(sub.lineno,
+                             f'for-loop collecting `== "{MARKER}"` '
+                             "instructions via "
+                             f".{call.func.attr}()", "for-collect")
+                        break
+                else:
+                    continue
+                break
+    return violations
+
+
+class JumpResolutionRule(LintRule):
+    code = "R7"
+    name = "jump-resolution"
+    description = ("jump-target resolution (JUMPDEST set construction) "
+                   "belongs to staticanalysis/ — consumers read the CFA "
+                   "tables via smt/solver/cfa_screen.py")
+
+    def run(self, ctx: LintContext) -> List[Violation]:
+        violations: List[Violation] = []
+        for path in ctx.iter_py(*SCAN_DIRS):
+            relpath = ctx.relpath(path)
+            if relpath.startswith(ALLOWED_PREFIX) \
+                    or relpath.startswith("tools/lint/") \
+                    or relpath == "tools/check_excepts.py" \
+                    or relpath.startswith("tests/data/lint/"):
+                continue
+            violations.extend(check_file(relpath, ctx.tree(path)))
+        return violations
+
+    def check_paths(self, ctx: LintContext, paths) -> List[Violation]:
+        violations: List[Violation] = []
+        for path in paths:
+            violations.extend(
+                check_file(ctx.relpath(path), ctx.tree(path)))
+        return violations
