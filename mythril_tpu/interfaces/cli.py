@@ -206,6 +206,104 @@ def _cmd_analyze(parser, cli_args, safe_functions: bool = False) -> int:
     return 1 if report.issues else 0
 
 
+def _add_serve_args(parser: argparse.ArgumentParser) -> None:
+    transport = parser.add_argument_group("transport")
+    transport.add_argument("--socket", default=None, metavar="PATH",
+                           help="unix-socket path (default: "
+                                "MYTHRIL_TPU_SERVE_SOCKET or "
+                                "~/.mythril_tpu/serve.sock)")
+    transport.add_argument("--stdio", action="store_true",
+                           help="serve one JSON-lines session on "
+                                "stdin/stdout instead of a socket "
+                                "(logs stay on stderr)")
+    transport.add_argument("--http", type=int, default=None, metavar="PORT",
+                           help="serve the thin HTTP shim on PORT instead "
+                                "of a socket (POST / = one protocol "
+                                "request; GET /healthz = ping)")
+    transport.add_argument("--http-host", default="127.0.0.1",
+                           help="bind address for --http")
+
+    daemon = parser.add_argument_group("daemon")
+    daemon.add_argument("--solver", default="cdcl", choices=["cdcl", "jax"],
+                        help="default SAT backend for requests that do not "
+                             "pick one")
+    daemon.add_argument("--engine", default="host", choices=["host", "tpu"],
+                        help="default exploration engine")
+    daemon.add_argument("--strategy", default="bfs",
+                        choices=["dfs", "bfs", "naive-random",
+                                 "weighted-random", "beam-search", "pending"])
+    daemon.add_argument("--manifest", default=None, metavar="PATH",
+                        help="warm-set manifest (default: "
+                             "MYTHRIL_TPU_SERVE_MANIFEST or "
+                             "~/.mythril_tpu/warmset.json)")
+    daemon.add_argument("--no-warmup", action="store_true",
+                        help="skip the startup AOT warmup phase")
+    daemon.add_argument("--max-inflight", type=int, default=None,
+                        help="admitted-but-unfinished request bound "
+                             "(default: MYTHRIL_TPU_SERVE_MAX_INFLIGHT)")
+
+
+def _cmd_serve(cli_args) -> int:
+    from ..serve.service import AnalysisService
+    from ..serve.warmset import default_manifest_path
+
+    service = AnalysisService(
+        solver=cli_args.solver, engine=cli_args.engine,
+        strategy=cli_args.strategy,
+        manifest_path=cli_args.manifest or default_manifest_path(),
+        warmup=False if cli_args.no_warmup else None,
+        max_inflight=cli_args.max_inflight)
+    if cli_args.stdio:
+        from ..serve.daemon import serve_stdio
+
+        serve_stdio(service)
+        return 0
+    if cli_args.http is not None:
+        from ..serve.http_shim import serve_http
+
+        serve_http(service, host=cli_args.http_host, port=cli_args.http)
+        return 0
+    from ..serve.daemon import serve_socket
+
+    serve_socket(service, socket_path=cli_args.socket)
+    return 0
+
+
+def _cmd_client(parser, cli_args) -> int:
+    from ..serve import client as serve_client
+
+    payload = {"op": cli_args.op}
+    if cli_args.id is not None:
+        payload["id"] = cli_args.id
+    if cli_args.op == "analyze":
+        code = cli_args.code
+        if code is None and cli_args.codefile:
+            with open(cli_args.codefile) as handle:
+                code = handle.read().strip()
+        if not code:
+            parser.error("client analyze needs -c or -f")
+        payload.update(
+            code=code, bin_runtime=cli_args.bin_runtime,
+            transaction_count=cli_args.transaction_count,
+            strategy=cli_args.strategy, max_depth=cli_args.max_depth)
+        if cli_args.modules:
+            payload["modules"] = cli_args.modules.split(",")
+        if cli_args.solver:
+            payload["solver"] = cli_args.solver
+        if cli_args.engine:
+            payload["engine"] = cli_args.engine
+        if cli_args.deadline_ms:
+            payload["deadline_ms"] = cli_args.deadline_ms
+    try:
+        reply = serve_client.request(payload, socket_path=cli_args.socket,
+                                     timeout=cli_args.timeout)
+    except serve_client.ServeClientError as error:
+        print(f"myth-tpu client: {error}", file=sys.stderr)
+        return 2
+    print(json.dumps(reply, indent=2, sort_keys=True))
+    return 0 if reply.get("ok") else 2
+
+
 def main(argv=None) -> int:
     from .. import __version__
 
@@ -263,6 +361,41 @@ def main(argv=None) -> int:
                                 help="signature lookup for a 4-byte selector")
     h2a.add_argument("hash")
 
+    serve = subparsers.add_parser(
+        "serve", help="run the persistent analysis daemon "
+                      "(JSON-lines over stdio/unix-socket/HTTP, "
+                      "AOT-warmed solver buckets)")
+    _add_serve_args(serve)
+
+    client = subparsers.add_parser(
+        "client", help="send one request to a running serve daemon")
+    client.add_argument("op", nargs="?", default="analyze",
+                        choices=["analyze", "ping", "status", "shutdown"])
+    client.add_argument("-c", "--code", help="hex creation bytecode")
+    client.add_argument("-f", "--codefile",
+                        help="file containing hex bytecode")
+    client.add_argument("--bin-runtime", action="store_true",
+                        help="treat -c/-f input as runtime (deployed) code")
+    client.add_argument("-m", "--modules",
+                        help="comma-separated detection module list")
+    client.add_argument("-t", "--transaction-count", type=int, default=2)
+    client.add_argument("--strategy", default="bfs",
+                        choices=["dfs", "bfs", "naive-random",
+                                 "weighted-random", "beam-search", "pending"])
+    client.add_argument("--max-depth", type=int, default=128)
+    client.add_argument("--solver", default=None, choices=["cdcl", "jax"])
+    client.add_argument("--engine", default=None, choices=["host", "tpu"])
+    client.add_argument("--deadline-ms", type=int, default=None,
+                        help="per-request analysis deadline (the daemon "
+                             "returns a partial report when it expires)")
+    client.add_argument("--id", default=None, help="request id to echo")
+    client.add_argument("--socket", default=None, metavar="PATH",
+                        help="daemon socket path (default: "
+                             "MYTHRIL_TPU_SERVE_SOCKET or "
+                             "~/.mythril_tpu/serve.sock)")
+    client.add_argument("--timeout", type=float, default=600.0,
+                        help="seconds to wait for the reply")
+
     subparsers.add_parser("list-detectors", help="list detection modules")
     subparsers.add_parser("version", help="print version")
 
@@ -315,6 +448,10 @@ def main(argv=None) -> int:
 
     MythrilPluginLoader().load_default_enabled()
 
+    if cli_args.command == "serve":
+        return _cmd_serve(cli_args)
+    if cli_args.command == "client":
+        return _cmd_client(parser, cli_args)
     if cli_args.command in ("analyze", "a"):
         return _cmd_analyze(parser, cli_args)
     if cli_args.command == "safe-functions":

@@ -149,6 +149,20 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("cfa.frontier.prefetch_skipped", COUNTER, "1",
                "Feasibility prefetches skipped for statically dead or "
                "invalid target pcs."),
+    # -- analysis service (mythril_tpu/serve/) -----------------------------------
+    MetricSpec("serve.requests", COUNTER, "1",
+               "Requests the analysis service finished (ok or error)."),
+    MetricSpec("serve.request_errors", COUNTER, "1",
+               "Requests answered with an error reply (malformed input, "
+               "failed analysis, unknown op)."),
+    MetricSpec("serve.busy_rejections", COUNTER, "1",
+               "Requests bounced with `busy` because the in-flight bound "
+               "(MYTHRIL_TPU_SERVE_MAX_INFLIGHT) was reached."),
+    MetricSpec("serve.warmed_buckets", COUNTER, "1",
+               "Clause-shape buckets pre-compiled by the AOT warmup "
+               "phase at daemon startup."),
+    MetricSpec("serve.request_ms", HISTOGRAM, "ms",
+               "Wall time of one analysis request, warmup excluded."),
     # -- engine plugins (core/plugin/plugins/) -----------------------------------
     MetricSpec("profiler.instruction_us", HISTOGRAM, "us",
                "Per-opcode host-engine instruction latency "

@@ -132,6 +132,13 @@ class Tracer:
 
     def enable(self, out_path: str) -> None:
         with self._lock:
+            if self.enabled and self.out_path == out_path:
+                # idempotent re-enable: a serve daemon enables the tracer
+                # at startup (warmup span) and each embedded analyzer
+                # re-enables the same path per request — resetting the
+                # buffer here would drop every span before the newest
+                # request
+                return
             self._checked_env = True
             self.out_path = out_path
             buffer_events = max(

@@ -29,6 +29,15 @@ successive queries of similar size reuse the compiled executable — path
 constraints grow a conjunct at a time, and per-query recompilation would
 dwarf the solve itself.
 
+Bucketing comes in two schemes (MYTHRIL_TPU_BUCKET_SCHEME): the default
+``coarse`` scheme rounds clause tiles, the variable axis, and the batch
+query axis to powers of FOUR (with a variable-axis floor), trading up to
+4x padded compute per step for a warm set small enough that `myth-tpu
+serve` can pre-compile every hot bucket at startup; ``fine`` keeps the
+original per-pow2 buckets for A/B measurement. The serve warm hooks at
+the bottom (observed_shape_keys / warm_shape_key) export and replay the
+shape keys this process has compiled.
+
 Model extraction returns the satisfying lane's assignment, consumed by
 smt/solver/solver.py exactly like a CDCL model.
 """
@@ -92,10 +101,50 @@ class _Problem(NamedTuple):
     n_vars: int            # real variable count (pre-padding)
 
 
+#: coarse-scheme floor for the padded variable axis: every query with
+#: fewer vars shares one bucket (the v1-wide per-step ops are cheap next
+#: to the tile scan, so a fat floor costs little and folds the long tail
+#: of small queries into a single pre-bakeable executable)
+COARSE_VARS_FLOOR = 1 << 10
+
+
 def _next_pow2(value: int) -> int:
     from .batch import next_pow2
 
     return next_pow2(value)
+
+
+def _next_pow4(value: int) -> int:
+    bucket = 1
+    while bucket < value:
+        bucket <<= 2
+    return bucket
+
+
+def _coarse_buckets() -> bool:
+    """Call-time scheme read: 'coarse' (default) unless the A/B knob says
+    'fine'."""
+    from ..support import tpu_config
+
+    return tpu_config.get_str("MYTHRIL_TPU_BUCKET_SCHEME") != "fine"
+
+
+def _bucket_tiles(tiles_needed: int) -> int:
+    if _coarse_buckets():
+        return _next_pow4(tiles_needed)
+    return _next_pow2(tiles_needed)
+
+
+def _bucket_vars(vars_needed: int) -> int:
+    if _coarse_buckets():
+        return max(COARSE_VARS_FLOOR, _next_pow4(vars_needed))
+    return _next_pow2(vars_needed)
+
+
+def _bucket_batch(queries_needed: int) -> int:
+    if _coarse_buckets():
+        return _next_pow4(queries_needed)
+    return _next_pow2(queries_needed)
 
 
 def _build_problem(clauses: List[List[int]], n_vars: int,
@@ -114,7 +163,7 @@ def _build_problem(clauses: List[List[int]], n_vars: int,
         clauses = rebuilt
 
     n_clauses = len(clauses)
-    n_tiles = _next_pow2(max(1, -(-n_clauses // TILE)))
+    n_tiles = _bucket_tiles(max(1, -(-n_clauses // TILE)))
     lits = np.zeros((n_tiles * TILE, max_len), dtype=np.int32)
     for i, clause in enumerate(clauses):
         lits[i, :len(clause)] = clause
@@ -123,7 +172,7 @@ def _build_problem(clauses: List[List[int]], n_vars: int,
 
     # bucket the variable axis; padded vars start pre-assigned (false, not on
     # the trail) so they are never decided and never block the SAT check
-    v1 = _next_pow2(n_vars + 1)
+    v1 = _bucket_vars(n_vars + 1)
     counts = np.zeros(v1, dtype=np.int64)
     for clause in clauses:
         for lit in clause:
@@ -442,11 +491,12 @@ def solve_cnf_device_batch(queries: List[Tuple[List[List[int]], int]],
     host, oversize queries return UNKNOWN (caller falls back to CDCL), and
     no query ever raises past the caller's classification layer.
 
-    Problems bucket by their padded (n_tiles, v1) shape — already pow2 from
-    _build_problem — and the query axis pads to pow2 by repeating the last
-    problem, so the vmapped runner's compile cache stays as small as the
-    single-query one's. The host loop early-exits a bucket once every REAL
-    query in it has a verdict (pad lanes never gate progress).
+    Problems bucket by their padded (n_tiles, v1) shape — already bucketed
+    by _build_problem (pow2, or the coarse pow4 scheme) — and the query
+    axis pads the same way by repeating the last problem, so the vmapped
+    runner's compile cache stays as small as the single-query one's. The
+    host loop early-exits a bucket once every REAL query in it has a
+    verdict (pad lanes never gate progress).
 
     `clause_cap=None` reads DEFAULT_CLAUSE_CAP at call time, so the
     dispatch layer (and tests) can tune the module global."""
@@ -474,7 +524,7 @@ def solve_cnf_device_batch(queries: List[Tuple[List[List[int]], int]],
     forced_depth = max(0, int(np.log2(max(1, n_probes))))
     for (n_tiles, v1), group in buckets.items():
         n_real = len(group)
-        n_padded = _next_pow2(n_real)
+        n_padded = _bucket_batch(n_real)
         problems = [problem for _, problem in group]
         problems += [problems[-1]] * (n_padded - n_real)
         try:
@@ -523,3 +573,117 @@ def solve_cnf_device_batch(queries: List[Tuple[List[List[int]], int]],
             else:
                 results[index] = (UNKNOWN, None)
     return results
+
+
+# -- serve warm hooks (mythril_tpu/serve/warmset.py) ---------------------------------
+
+#: sanity bounds for manifest-sourced shape keys — a corrupt or hostile
+#: manifest must not allocate arbitrary device memory at daemon startup
+_WARM_MAX_TILES = 1 << 12
+_WARM_MAX_VARS = 1 << 22
+_WARM_MAX_PROBES = 1 << 10
+_WARM_MAX_BATCH = 1 << 12
+_WARM_MAX_CHUNK = 1 << 12
+
+
+def observed_shape_keys() -> List[tuple]:
+    """Snapshot of every runner shape key invoked this process — the
+    serve warm-set exports these to the warmup manifest so the next
+    daemon can pre-compile them before taking traffic."""
+    return sorted(_SHAPES_RUN)
+
+
+def warm_shape_key(key) -> bool:
+    """Pre-compile one runner shape bucket by invoking it once on a
+    synthetic zero-clause problem of exactly that padded shape.
+
+    Calling the jitted runner (rather than ``.lower().compile()`` alone)
+    is deliberate: the AOT path produces a compiled object but leaves the
+    call-site jit cache cold, so the first real query would still pay
+    tracing plus a persistent-cache load. One throwaway invocation puts
+    the executable in the exact cache real queries hit, and routes through
+    ``_run_accounted`` so the compile is attributed to the warmup span,
+    not the first request. Returns False (never raises) for malformed
+    keys, out-of-bounds shapes, or sharded keys the current mesh cannot
+    host — a stale manifest must not take the daemon down."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        key = tuple(key)
+        kind = key[0]
+        if kind == "single":
+            _, n_devices, chunk, forced_depth, n_tiles, v1, n_probes = key
+            n_padded = 0
+        elif kind == "batch":
+            _, chunk, forced_depth, n_tiles, v1, n_padded, n_probes = key
+            n_devices = 1
+        else:
+            return False
+        dims = [n_devices, chunk, forced_depth, n_tiles, v1, n_probes]
+        if kind == "batch":
+            dims.append(n_padded)
+        if not all(isinstance(d, int) and d >= 0 for d in dims):
+            return False
+        if not (0 < n_tiles <= _WARM_MAX_TILES
+                and 0 < v1 <= _WARM_MAX_VARS
+                and 0 < n_probes <= _WARM_MAX_PROBES
+                and 0 < chunk <= _WARM_MAX_CHUNK
+                and forced_depth <= 30
+                and (kind != "batch" or 0 < n_padded <= _WARM_MAX_BATCH)):
+            return False
+    except (TypeError, ValueError, IndexError):
+        return False
+    if key in _SHAPES_RUN:
+        return True
+
+    if n_devices > 1:
+        if len(jax.devices()) < n_devices or n_tiles % n_devices:
+            return False
+        runner, _ = _get_sharded_runner(chunk, forced_depth, n_devices)
+    elif kind == "batch":
+        runner = _get_batch_runner(chunk, forced_depth)
+    else:
+        runner = _get_runner(chunk, forced_depth)
+
+    # a zero-clause problem: every tile row is padding, so the lanes just
+    # decide variables for `chunk` steps — same shapes/dtypes as a real
+    # query (the jit cache key), trivial work
+    lits = np.zeros((n_tiles, TILE, 3), dtype=np.int32)
+    valid = np.zeros((n_tiles, TILE), dtype=bool)
+    order = np.arange(v1, dtype=np.int32)
+    assign = np.zeros((n_probes, v1), dtype=np.int8)
+    if kind == "batch":
+        state = _SolverState(
+            assign=jnp.asarray(np.broadcast_to(
+                assign, (n_padded, n_probes, v1))),
+            trail=jnp.zeros((n_padded, n_probes, v1), dtype=jnp.int32),
+            tag=jnp.zeros((n_padded, n_probes, v1), dtype=jnp.int8),
+            trail_len=jnp.zeros((n_padded, n_probes), dtype=jnp.int32),
+            status=jnp.zeros((n_padded, n_probes), dtype=jnp.int8),
+        )
+        lits_dev = jnp.asarray(np.broadcast_to(lits, (n_padded,) + lits.shape))
+        valid_dev = jnp.asarray(np.broadcast_to(
+            valid, (n_padded,) + valid.shape))
+        order_dev = jnp.asarray(np.broadcast_to(order, (n_padded, v1)))
+    else:
+        state = _SolverState(
+            assign=jnp.broadcast_to(jnp.asarray(assign[0]), (n_probes, v1)),
+            trail=jnp.zeros((n_probes, v1), dtype=jnp.int32),
+            tag=jnp.zeros((n_probes, v1), dtype=jnp.int8),
+            trail_len=jnp.zeros(n_probes, dtype=jnp.int32),
+            status=jnp.zeros(n_probes, dtype=jnp.int8),
+        )
+        lits_dev, valid_dev, order_dev = (jnp.asarray(lits),
+                                          jnp.asarray(valid),
+                                          jnp.asarray(order))
+    try:
+        _run_accounted(runner, key, state, lits_dev, valid_dev, order_dev)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        # warming is an optimization: an uncompilable key (e.g. a manifest
+        # from a different mesh) must not take the daemon down
+        _SHAPES_RUN.discard(key)
+        return False
+    return True

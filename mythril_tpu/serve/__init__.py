@@ -1,0 +1,20 @@
+"""`myth-tpu serve`: a persistent analysis service with AOT-warmed
+executables.
+
+The cold-start tax on the device path is XLA compilation: the first
+solve per clause-shape bucket costs minutes, every later solve in the
+same bucket costs milliseconds. A one-shot CLI run pays that tax every
+invocation; this package amortizes it across a process lifetime instead:
+
+* ``protocol``  — JSON-lines request framing + validation (stdlib-only)
+* ``service``   — AnalysisService: admission gate, engine lock,
+  per-request isolation, warm/cold accounting
+* ``warmset``   — persisted manifest of hot clause-shape buckets +
+  startup warmup (``serve.warmup`` trace span)
+* ``daemon``    — stdio and unix-socket transport loops
+* ``http_shim`` — thin POST shim over the same service
+* ``client``    — socket client used by `myth-tpu client`
+
+Submodules are imported lazily by the CLI so that client-side commands
+never pay the engine import.
+"""

@@ -1,0 +1,68 @@
+"""Client side of the serve protocol: connect, send, read replies.
+
+Stdlib-only and jax-free — importing this never touches the engine, so
+`myth-tpu client` stays instant even when the daemon is mid-warmup.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Dict, List, Optional
+
+from . import protocol
+from .daemon import default_socket_path
+
+
+class ServeClientError(RuntimeError):
+    """Connection-level failure talking to the daemon (the daemon's own
+    typed errors come back as normal replies, not exceptions)."""
+
+
+def roundtrip(requests: List[Dict], socket_path: Optional[str] = None,
+              timeout: float = 600.0) -> List[Dict]:
+    """Send request dicts over one connection; return one reply dict per
+    request, in order."""
+    path = socket_path or default_socket_path()
+    connection = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    connection.settimeout(timeout)
+    try:
+        connection.connect(path)
+    except OSError as error:
+        connection.close()
+        raise ServeClientError(
+            f"no daemon at {path} ({error}); start one with "
+            f"`myth-tpu serve`") from error
+    replies: List[Dict] = []
+    try:
+        with connection:
+            wfile = connection.makefile("wb")
+            rfile = connection.makefile("rb")
+            for request in requests:
+                wfile.write(protocol.encode(request).encode("utf-8"))
+            wfile.flush()
+            connection.shutdown(socket.SHUT_WR)
+            for line in rfile:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    replies.append(json.loads(line))
+                except ValueError as error:
+                    raise ServeClientError(
+                        f"malformed reply from daemon: {error}")
+    except socket.timeout as error:
+        raise ServeClientError(
+            f"daemon did not reply within {timeout:.0f}s") from error
+    if len(replies) < len(requests):
+        raise ServeClientError(
+            f"daemon closed the connection after {len(replies)} of "
+            f"{len(requests)} replies")
+    return replies
+
+
+def request(payload: Dict, socket_path: Optional[str] = None,
+            timeout: float = 600.0) -> Dict:
+    """One request, one reply."""
+    return roundtrip([payload], socket_path=socket_path,
+                     timeout=timeout)[0]

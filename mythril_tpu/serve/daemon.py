@@ -1,0 +1,134 @@
+"""Transport loops for the serve daemon: stdio and unix socket.
+
+Both speak the JSON-lines protocol (serve/protocol.py) and funnel every
+frame through one shared :class:`~.service.AnalysisService` — transports
+own bytes and connection lifecycle, the service owns admission,
+isolation, and the engine. The socket server takes one reader thread per
+connection (the service's in-flight gate bounds concurrent work), stdio
+is a single foreground loop. Either exits cleanly when a ``shutdown``
+request drains the service.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import sys
+import threading
+from typing import Optional
+
+from . import protocol
+from ..support import tpu_config
+
+log = logging.getLogger(__name__)
+
+
+def default_socket_path() -> str:
+    """MYTHRIL_TPU_SERVE_SOCKET, or ~/.mythril_tpu/serve.sock."""
+    configured = tpu_config.get_str("MYTHRIL_TPU_SERVE_SOCKET")
+    if configured:
+        return configured
+    base = tpu_config.get_str(
+        "MYTHRIL_TPU_DIR",
+        os.path.join(os.path.expanduser("~"), ".mythril_tpu"))
+    return os.path.join(base, "serve.sock")
+
+
+def serve_stream(service, rfile, wfile) -> int:
+    """Serve one bidirectional byte stream until EOF or shutdown.
+    Returns the number of frames answered. This is the whole protocol
+    loop for stdio mode and for each socket connection."""
+    answered = 0
+    for item in protocol.iter_requests(rfile):
+        reply = service.handle(item)
+        wfile.write(protocol.encode(reply).encode("utf-8"))
+        wfile.flush()
+        answered += 1
+        if service.shutting_down.is_set():
+            break
+    return answered
+
+
+def serve_stdio(service, stdin=None, stdout=None) -> int:
+    """Foreground stdio mode: requests on stdin, replies on stdout
+    (logs must go to stderr — the CLI wires that up)."""
+    rfile = stdin if stdin is not None else sys.stdin.buffer
+    wfile = stdout if stdout is not None else sys.stdout.buffer
+    service.startup()
+    try:
+        return serve_stream(service, rfile, wfile)
+    finally:
+        service.shutdown()
+
+
+def _connection_worker(service, connection) -> None:
+    try:
+        with connection:
+            rfile = connection.makefile("rb")
+            wfile = connection.makefile("wb")
+            serve_stream(service, rfile, wfile)
+    except (BrokenPipeError, ConnectionResetError):
+        pass  # client went away mid-reply; nothing to clean up
+    except Exception:
+        log.exception("serve connection failed")
+
+
+def serve_socket(service, socket_path: Optional[str] = None,
+                 ready_event: Optional[threading.Event] = None) -> int:
+    """Unix-socket mode: accept loop in this thread, one reader thread
+    per connection. Blocks until a ``shutdown`` request (or
+    KeyboardInterrupt) drains the service; returns the number of
+    connections accepted. ``ready_event`` fires once the socket is bound
+    and warmup has finished — tests and supervisors wait on it."""
+    path = socket_path or default_socket_path()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    if os.path.exists(path):
+        # a live daemon would be reachable; a stale socket file from a
+        # crashed one just blocks bind() — probe before unlinking
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            probe.connect(path)
+        except OSError:
+            os.unlink(path)
+        else:
+            probe.close()
+            raise RuntimeError(f"daemon already listening on {path}")
+    server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    accepted = 0
+    try:
+        server.bind(path)
+        os.chmod(path, 0o600)
+        server.listen(8)
+        server.settimeout(0.25)
+        service.startup()
+        if ready_event is not None:
+            ready_event.set()
+        log.info("serving on %s (max_inflight=%d)", path,
+                 service.max_inflight)
+        workers = []
+        while not service.shutting_down.is_set():
+            try:
+                connection, _ = server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            accepted += 1
+            worker = threading.Thread(
+                target=_connection_worker, args=(service, connection),
+                name=f"serve-conn-{accepted}", daemon=True)
+            worker.start()
+            workers.append(worker)
+        for worker in workers:
+            worker.join(timeout=5.0)
+    except KeyboardInterrupt:
+        log.info("interrupted — draining")
+    finally:
+        service.shutdown()
+        server.close()
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    return accepted

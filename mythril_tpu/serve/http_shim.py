@@ -1,0 +1,100 @@
+"""Thin HTTP shim over the serve protocol.
+
+``POST /`` with one protocol request object as the JSON body returns the
+reply as the JSON response body — the same validation, admission, and
+isolation as the socket path, because every request still goes through
+``AnalysisService.handle``. ``GET /healthz`` answers a ping without
+touching the engine. This is deliberately a shim, not a web framework:
+stdlib ``http.server`` only, one process, no TLS — put a real proxy in
+front if this ever leaves localhost.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import protocol
+
+log = logging.getLogger(__name__)
+
+#: matches the protocol's per-line bound; requests beyond it are 413
+MAX_BODY_BYTES = protocol.MAX_LINE_BYTES
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service = None  # injected by serve_http via type()
+
+    def log_message(self, fmt, *args):  # route access logs to logging
+        log.debug("http: " + fmt, *args)
+
+    def _reply(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload, sort_keys=True).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path != "/healthz":
+            self._reply(404, protocol.error_reply(
+                None, "bad_request", "GET supports /healthz only"))
+            return
+        reply = self.service.handle(
+            protocol.Request("ping", "healthz", {}))
+        self._reply(200, reply)
+
+    def do_POST(self):
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            length = -1
+        if length < 0:
+            self._reply(411, protocol.error_reply(
+                None, "bad_request", "Content-Length required"))
+            return
+        if length > MAX_BODY_BYTES:
+            self._reply(413, protocol.error_reply(
+                None, "line_too_long",
+                f"body exceeds {MAX_BODY_BYTES} bytes"))
+            return
+        body = self.rfile.read(length)
+        try:
+            request = protocol.parse_request(body)
+        except protocol.ProtocolError as error:
+            self._reply(400, protocol.error_reply(
+                error.request_id, error.code, error.message))
+            return
+        reply = self.service.handle(request)
+        status = 200 if reply.get("ok") else \
+            (429 if reply["error"]["code"] == "busy" else 400)
+        self._reply(status, reply)
+
+
+def serve_http(service, host: str = "127.0.0.1", port: int = 8551,
+               ready_event=None) -> int:
+    """Serve HTTP until a ``shutdown`` request drains the service.
+    Returns the bound port (useful with ``port=0`` in tests)."""
+    handler = type("BoundHandler", (_Handler,), {"service": service})
+    server = ThreadingHTTPServer((host, port), handler)
+    server.timeout = 0.25
+    server.daemon_threads = True
+    bound_port = server.server_address[1]
+    service.http_port = bound_port  # visible before the loop: port=0
+    # callers (tests, supervisors) read the ephemeral port from here
+    try:
+        service.startup()
+        if ready_event is not None:
+            ready_event.set()
+        log.info("serving HTTP on %s:%d", host, bound_port)
+        while not service.shutting_down.is_set():
+            server.handle_request()
+    except KeyboardInterrupt:
+        log.info("interrupted — draining")
+    finally:
+        service.shutdown()
+        server.server_close()
+    return bound_port

@@ -1,0 +1,212 @@
+"""AnalysisService: the daemon's request executor.
+
+One instance owns the warm set, the admission gate, and the engine lock;
+the stdio loop, the unix-socket server, and the HTTP shim all funnel
+into :meth:`handle`, so every transport shares one behavior:
+
+* **Admission** is bounded by ``MYTHRIL_TPU_SERVE_MAX_INFLIGHT``: a
+  request beyond the bound is answered ``busy`` immediately (counted in
+  ``serve.busy_rejections``) instead of queueing unboundedly.
+* **Execution** is serialized on one engine lock — the symbolic engine,
+  the solver pipeline, and the dispatch queue are all single-threaded
+  process singletons. Admitted requests wait on the lock; the in-flight
+  bound caps how many can wait.
+* **Isolation**: each analyze request starts from
+  ``reset_solver_backend(keep_verdicts=True)`` — fresh incremental
+  pipeline, fresh breaker/fault state (a quarantine belongs to the
+  request that suffered it), reset callback modules — while the
+  canonical-CNF verdict cache and every compiled XLA executable stay
+  warm (that is the whole point of the daemon).
+* **Deadlines** ride the engine's deadline-drain substrate (PR 2): the
+  request's ``deadline_ms`` becomes the analysis execution timeout, so
+  an over-budget contract yields ``incomplete: true`` plus coverage
+  stats, never a wedged queue.
+* **Accounting**: every request runs inside a ``serve.request`` trace
+  span carrying the request id and its warm/cold dispatch counts
+  (``xla.bucket_compiles``/``bucket_reuses`` deltas), which is what
+  ``tools/traceview.py``'s per-request rollup renders.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from . import protocol
+from .warmset import WarmSet
+from ..observe import metrics, trace
+from ..support import tpu_config
+
+log = logging.getLogger(__name__)
+
+
+class _RequestArgs:
+    """Namespace handed to MythrilAnalyzer as cmd_args (it getattr()s
+    every field with a default, so only overrides need to exist)."""
+
+
+class AnalysisService:
+    def __init__(self, solver: str = "cdcl", engine: str = "host",
+                 strategy: str = "bfs",
+                 manifest_path: Optional[str] = None,
+                 warmup: Optional[bool] = None,
+                 max_inflight: Optional[int] = None):
+        self.solver = solver
+        self.engine = engine
+        self.strategy = strategy
+        self.warmset = WarmSet(manifest_path)
+        if warmup is None:
+            warmup = tpu_config.get_flag("MYTHRIL_TPU_SERVE_WARMUP")
+        self.warmup_enabled = warmup
+        if max_inflight is None:
+            max_inflight = tpu_config.get_int("MYTHRIL_TPU_SERVE_MAX_INFLIGHT")
+        self.max_inflight = max(1, max_inflight)
+        self._gate = threading.BoundedSemaphore(self.max_inflight)
+        self._engine_lock = threading.Lock()
+        self._started = time.monotonic()
+        self._requests_done = 0
+        self.shutting_down = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------------------
+
+    def startup(self) -> None:
+        """Warm the solver buckets from the manifest (when enabled) and
+        stamp the trace manifest. Runs before the first request."""
+        # enable the span tracer now, not at first analyze: the warmup
+        # span must land in the trace for traceview's serve rollup
+        trace_out = tpu_config.get_str("MYTHRIL_TPU_TRACE")
+        if trace_out and not trace.enabled():
+            trace.enable(trace_out)
+        trace.set_manifest(serve_solver=self.solver,
+                           serve_engine=self.engine)
+        if self.warmup_enabled:
+            self.warmset.warmup()
+            self.warmset.record_observed()
+
+    def shutdown(self) -> None:
+        self.shutting_down.set()
+        self.warmset.record_observed()
+        trace.export()
+
+    def uptime_s(self) -> float:
+        return time.monotonic() - self._started
+
+    # -- request handling --------------------------------------------------------------
+
+    def handle(self, request) -> Dict:
+        """One reply dict for one parsed request (or for the
+        ProtocolError a transport's parser produced)."""
+        if isinstance(request, protocol.ProtocolError):
+            metrics.inc("serve.request_errors")
+            return protocol.error_reply(request.request_id, request.code,
+                                        request.message)
+        if self.shutting_down.is_set() and request.op != "shutdown":
+            return protocol.error_reply(request.id, "shutting_down",
+                                        "daemon is draining")
+        if request.op == "ping":
+            return protocol.ok_reply(request.id, pong=True,
+                                     uptime_s=round(self.uptime_s(), 3))
+        if request.op == "status":
+            return self._status(request)
+        if request.op == "shutdown":
+            self.shutting_down.set()
+            return protocol.ok_reply(request.id, shutdown=True,
+                                     requests_served=self._requests_done)
+        # analyze: bounded admission, serialized execution
+        if not self._gate.acquire(blocking=False):
+            metrics.inc("serve.busy_rejections")
+            return protocol.error_reply(
+                request.id, "busy",
+                f"{self.max_inflight} requests already in flight")
+        try:
+            with self._engine_lock:
+                return self._analyze(request)
+        finally:
+            self._gate.release()
+
+    def _status(self, request) -> Dict:
+        from ..smt.solver import dispatch
+
+        return protocol.ok_reply(
+            request.id,
+            uptime_s=round(self.uptime_s(), 3),
+            requests_served=self._requests_done,
+            solver=self.solver, engine=self.engine,
+            max_inflight=self.max_inflight,
+            warmset=self.warmset.status(),
+            cached_verdicts=dispatch.cached_verdicts(),
+            metrics=metrics.snapshot())
+
+    def _analyze(self, request) -> Dict:
+        params = request.params
+        started = time.monotonic()
+        cold_before = metrics.value("xla.bucket_compiles")
+        warm_before = metrics.value("xla.bucket_reuses")
+        with trace.span("serve.request",
+                        request_id=str(request.id)) as span:
+            try:
+                payload = self._run_analysis(params)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as error:
+                log.exception("analysis failed for request %r", request.id)
+                metrics.inc("serve.requests")
+                metrics.inc("serve.request_errors")
+                span.set(error=repr(error))
+                return protocol.error_reply(
+                    request.id, "analysis_failed",
+                    f"{type(error).__name__}: {error}")
+            cold = metrics.value("xla.bucket_compiles") - cold_before
+            warm = metrics.value("xla.bucket_reuses") - warm_before
+            span.set(cold_buckets=cold, warm_hits=warm,
+                     issues=payload["issue_count"])
+        elapsed_ms = (time.monotonic() - started) * 1000.0
+        metrics.inc("serve.requests")
+        metrics.observe("serve.request_ms", elapsed_ms)
+        self._requests_done += 1
+        self.warmset.record_observed()
+        return protocol.ok_reply(
+            request.id,
+            elapsed_ms=round(elapsed_ms, 3),
+            warm={"cold_buckets": cold, "warm_hits": warm},
+            **payload)
+
+    def _run_analysis(self, params: Dict) -> Dict:
+        """The per-request engine run: isolate, load, fire lasers."""
+        from ..analysis.security import reset_callback_modules
+        from ..mythril import MythrilAnalyzer, MythrilDisassembler
+        from ..smt.solver.solver import reset_solver_backend
+
+        # fresh pipeline/breaker/clock per request; verdict cache and the
+        # compiled executables survive (DispatchQueue.reset keep_verdicts)
+        reset_solver_backend(keep_verdicts=True)
+        reset_callback_modules()
+
+        cmd = _RequestArgs()
+        cmd.solver = params.get("solver") or self.solver
+        cmd.engine = params.get("engine") or self.engine
+        cmd.max_depth = params["max_depth"]
+        deadline_ms = params.get("deadline_ms")
+        if deadline_ms:
+            cmd.execution_timeout = max(deadline_ms / 1000.0, 0.001)
+        else:
+            cmd.execution_timeout = 86400
+        disassembler = MythrilDisassembler()
+        address, _ = disassembler.load_from_bytecode(
+            params["code"], params["bin_runtime"])
+        analyzer = MythrilAnalyzer(
+            disassembler, cmd_args=cmd,
+            strategy=params.get("strategy") or self.strategy,
+            address=address)
+        report = analyzer.fire_lasers(
+            modules=params.get("modules"),
+            transaction_count=params["transaction_count"])
+        return {
+            "issue_count": len(report.issues),
+            "incomplete": bool(getattr(report, "incomplete", False)),
+            "coverage": getattr(report, "coverage", {}) or {},
+            "report": json.loads(report.as_json()),
+        }

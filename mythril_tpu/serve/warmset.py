@@ -1,0 +1,173 @@
+"""WarmSet: the persisted registry of hot clause-shape buckets.
+
+The cold-start problem (BENCH_r05, traceview per-shape accounting): the
+first device solve per clause-shape bucket pays an XLA compile — ~112 s
+of it before the first useful step on the TPU path. The serve daemon
+kills it in two moves:
+
+1. **Coarse canonicalization** (parallel/jax_solver.py, the default
+   ``MYTHRIL_TPU_BUCKET_SCHEME=coarse``): tiles/vars/batch round to
+   powers of four with a variable-axis floor, so real traffic lands in a
+   handful of fat buckets instead of a long pow2 tail.
+2. **Manifest-driven AOT warmup** (this module): every run records the
+   shape keys its runners actually compiled
+   (``jax_solver.observed_shape_keys()``, the same accounting behind the
+   ``xla.bucket_compiles`` metric); the daemon replays the manifest
+   through ``jax_solver.warm_shape_key`` at startup — inside the
+   ``serve.warmup`` trace span — so requests arriving after warmup hit
+   only warm buckets (asserted end to end via ``xla.bucket_reuses``).
+
+Manifest format (JSON, versioned)::
+
+    {"version": 1,
+     "shapes": [["single", 1, 256, 5, 1, 1024, 32],
+                ["batch", 256, 5, 1, 1024, 4, 32], ...]}
+
+Shape entries are exactly the runner shape keys from
+``parallel/jax_solver.py`` (kind, then the jit-cache dimensions). The
+manifest merges monotonically: saving unions the shapes already on disk
+with the ones observed this process, so a fleet of daemons sharing one
+manifest only ever grows its warm set. Writes go through the fsync-atomic
+``support/checkpoint.fsync_replace`` (PR 2), so a crashed daemon never
+leaves a torn manifest behind. Unknown versions and malformed entries
+load as empty/skipped — a stale manifest degrades to a cold start, never
+a crash.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import List, Optional, Tuple
+
+from ..observe import metrics, trace
+from ..support import tpu_config
+from ..support.checkpoint import fsync_replace
+
+log = logging.getLogger(__name__)
+
+MANIFEST_VERSION = 1
+
+
+def default_manifest_path() -> str:
+    """MYTHRIL_TPU_SERVE_MANIFEST, or ~/.mythril_tpu/warmset.json."""
+    configured = tpu_config.get_str("MYTHRIL_TPU_SERVE_MANIFEST")
+    if configured:
+        return configured
+    base = tpu_config.get_str(
+        "MYTHRIL_TPU_DIR",
+        os.path.join(os.path.expanduser("~"), ".mythril_tpu"))
+    return os.path.join(base, "warmset.json")
+
+
+def load_manifest(path: str) -> List[Tuple]:
+    """Shape keys from a manifest file; [] for missing, malformed, or
+    unknown-version manifests (each skip is logged, never raised)."""
+    try:
+        with open(path, "r", encoding="utf-8") as handle:
+            doc = json.load(handle)
+    except FileNotFoundError:
+        return []
+    except (OSError, ValueError) as error:
+        log.warning("warmset manifest %s unreadable (%s) — cold start",
+                    path, error)
+        return []
+    if not isinstance(doc, dict) or doc.get("version") != MANIFEST_VERSION:
+        log.warning("warmset manifest %s has unsupported version %r — "
+                    "cold start", path,
+                    doc.get("version") if isinstance(doc, dict) else None)
+        return []
+    shapes = []
+    for entry in doc.get("shapes") or []:
+        if isinstance(entry, list) and entry \
+                and isinstance(entry[0], str) \
+                and all(isinstance(dim, int) for dim in entry[1:]):
+            shapes.append(tuple(entry))
+        else:
+            log.warning("warmset manifest %s: skipping malformed entry %r",
+                        path, entry)
+    return shapes
+
+
+def save_manifest(path: str, shapes: List[Tuple]) -> int:
+    """Merge `shapes` into the manifest at `path` (union with what is
+    already there) and write it fsync-atomically. Returns the merged
+    shape count."""
+    merged = sorted(set(load_manifest(path)) | {tuple(s) for s in shapes})
+    payload = {"version": MANIFEST_VERSION,
+               "shapes": [list(shape) for shape in merged]}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as handle:
+        json.dump(payload, handle, indent=1)
+    fsync_replace(tmp, path)
+    return len(merged)
+
+
+class WarmSet:
+    """The daemon's view of the warm buckets: load → warm → record.
+
+    ``path=None`` disables persistence (warmup still works off whatever
+    shapes the caller seeds via :meth:`warm`)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.warmed: List[Tuple] = []
+        self.failed: List[Tuple] = []
+
+    def warmup(self) -> int:
+        """Pre-compile every manifest bucket, inside one ``serve.warmup``
+        span (traceview attributes the compile cliff to warmup, not to
+        the first request). Returns the bucket count actually warmed."""
+        shapes = load_manifest(self.path) if self.path else []
+        # the span is emitted even for an empty manifest: traceview's
+        # serve section attributes warmup separately from request time,
+        # and "0 buckets warmed" is a finding, not an absence
+        with trace.span("serve.warmup", buckets=len(shapes)) as span:
+            if shapes:
+                from ..parallel import jax_solver
+
+                for shape in shapes:
+                    if jax_solver.warm_shape_key(shape):
+                        self.warmed.append(shape)
+                        metrics.inc("serve.warmed_buckets")
+                    else:
+                        self.failed.append(shape)
+            span.set(warmed=len(self.warmed), failed=len(self.failed))
+        if self.failed:
+            log.warning("warmup skipped %d un-warmable manifest shapes "
+                        "(different mesh or malformed): %s",
+                        len(self.failed), self.failed[:4])
+        log.info("warmup pre-compiled %d clause-shape buckets",
+                 len(self.warmed))
+        return len(self.warmed)
+
+    def record_observed(self) -> int:
+        """Persist every shape this process has compiled so far (warmup
+        plus live traffic) back into the manifest. Called after each
+        request and at shutdown — the next daemon starts at least this
+        warm. No-op (returning 0) without a manifest path."""
+        if not self.path:
+            return 0
+        from ..parallel import jax_solver
+
+        observed = jax_solver.observed_shape_keys()
+        if not observed:
+            return 0
+        try:
+            return save_manifest(self.path, observed)
+        except OSError as error:
+            log.warning("could not persist warmset manifest %s: %s",
+                        self.path, error)
+            return 0
+
+    def status(self) -> dict:
+        from ..parallel import jax_solver
+
+        return {
+            "manifest": self.path,
+            "warmed_buckets": len(self.warmed),
+            "unwarmable_buckets": len(self.failed),
+            "observed_buckets": len(jax_solver.observed_shape_keys()),
+        }

@@ -226,14 +226,22 @@ class DispatchQueue:
         self.pending.clear()
         self._execute_batch(entries, batched=True)
 
-    def reset(self) -> None:
+    def reset(self, keep_verdicts: bool = False) -> None:
         """Fresh analysis: drop the queue (dangling futures fail closed as
-        UNKNOWN) and the verdict cache (cached models reference a discarded
-        pipeline's variable numbering)."""
+        UNKNOWN) and, by default, the verdict cache.
+
+        ``keep_verdicts=True`` is the serve-daemon mode: the cache keys are
+        canonical CNFs, and SAT/UNSAT (plus any model) is a property of the
+        clause set itself — independent of which analysis's variable
+        numbering produced it — so verdicts stay sound across requests and
+        repeat analyses of similar contracts start warm. The default stays
+        conservative for single-analysis runs and tests that assert exact
+        device-consultation counts."""
         for entry in self.pending.values():
             entry.result = (sat.UNKNOWN, None)
         self.pending.clear()
-        self.cache.clear()
+        if not keep_verdicts:
+            self.cache.clear()
 
     # -- the device boundary ---------------------------------------------------------
 
@@ -375,5 +383,9 @@ def pending_count() -> int:
     return len(_QUEUE.pending)
 
 
-def reset() -> None:
-    _QUEUE.reset()
+def cached_verdicts() -> int:
+    return len(_QUEUE.cache)
+
+
+def reset(keep_verdicts: bool = False) -> None:
+    _QUEUE.reset(keep_verdicts=keep_verdicts)

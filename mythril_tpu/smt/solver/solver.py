@@ -200,22 +200,27 @@ def _get_pipeline():
     return _pipeline
 
 
-def reset_solver_backend() -> None:
+def reset_solver_backend(keep_verdicts: bool = False) -> None:
     """Drop the process-wide incremental pipeline and the model caches.
 
     Per-query cost grows with the monotone pool (the session re-propagates
     its whole trail); a fresh analysis — or a test that asserts exact
     sat/unsat behavior — can call this to shed state accumulated by earlier
-    heavy workloads."""
+    heavy workloads.
+
+    ``keep_verdicts=True`` preserves the dispatch layer's canonical-CNF
+    verdict cache across the reset — the serve daemon's between-requests
+    mode (verdicts are properties of the clause set, sound across
+    pipelines; see dispatch.DispatchQueue.reset)."""
     global _pipeline
     if _pipeline is not None:
         _pipeline.close()
         _pipeline = None
-    # in-flight batch entries and cached verdicts reference the discarded
-    # pipeline's variable numbering — drop them with it
+    # in-flight batch entries die with the discarded pipeline; cached
+    # verdicts are keyed on canonical CNFs and may outlive it on request
     from . import dispatch
 
-    dispatch.reset()
+    dispatch.reset(keep_verdicts=keep_verdicts)
     from ...support import model as model_service
 
     model_service.reset_model_caches()

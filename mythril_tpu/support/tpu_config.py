@@ -70,6 +70,11 @@ _KNOBS: List[Knob] = [
     # -- batched SAT dispatch ----------------------------------------------------
     Knob("MYTHRIL_TPU_BATCH_FLUSH", "int", 16,
          "Queued SAT queries that trigger a batched device flush."),
+    Knob("MYTHRIL_TPU_BUCKET_SCHEME", "str", "coarse",
+         "Clause-shape bucketing for the device SAT runners: 'coarse' "
+         "(default) rounds tiles/vars/batch to powers of four with a "
+         "variable-axis floor so the warm set stays small enough to "
+         "pre-bake; 'fine' keeps the original per-pow2 buckets (A/B)."),
     Knob("MYTHRIL_TPU_BATCH_AGE_MS", "float", 50.0,
          "Max age (ms) a queued SAT query may wait before a flush."),
     Knob("MYTHRIL_TPU_VERDICT_CACHE", "int", 4096,
@@ -95,6 +100,20 @@ _KNOBS: List[Knob] = [
          "~/.mythril_tpu)."),
     Knob("MYTHRIL_TPU_RPC", "str", None,
          "Default RPC endpoint preset for dynamic loading."),
+    # -- analysis service (mythril_tpu/serve/) ------------------------------------
+    Knob("MYTHRIL_TPU_SERVE_SOCKET", "str", None,
+         "Unix-socket path for `myth-tpu serve` / `myth-tpu client` "
+         "(dynamic default: ~/.mythril_tpu/serve.sock)."),
+    Knob("MYTHRIL_TPU_SERVE_MANIFEST", "str", None,
+         "Warm-set manifest path: clause-shape buckets observed in prior "
+         "runs, pre-compiled at daemon startup (dynamic default: "
+         "~/.mythril_tpu/warmset.json)."),
+    Knob("MYTHRIL_TPU_SERVE_MAX_INFLIGHT", "int", 4,
+         "Admitted-but-unfinished serve requests; beyond it the daemon "
+         "answers `busy` instead of queueing unboundedly."),
+    Knob("MYTHRIL_TPU_SERVE_WARMUP", "flag", True,
+         "Run the AOT warmup phase (manifest-driven bucket pre-compile) "
+         "at daemon startup; `serve --no-warmup` also disables it."),
     # -- observability (mythril_tpu/observe/) -------------------------------------
     Knob("MYTHRIL_TPU_TRACE", "str", None,
          "Write a Chrome/Perfetto trace_event JSON to this path; setting "

@@ -85,6 +85,36 @@ def test_report_sections_on_golden_fixture():
     assert "failure_class=device_oom" in text
 
 
+def test_serve_section_absent_for_non_serve_traces():
+    events, other = load_trace(GOLDEN)
+    assert "== serve (warmup vs requests) ==" not in report(events, other)
+
+
+def test_serve_section_rolls_up_warmup_and_requests():
+    events = [
+        {"ph": "X", "name": "serve.warmup", "cat": "serve", "ts": 0,
+         "dur": 2_000_000,
+         "args": {"buckets": 3, "warmed": 2, "failed": 1}},
+        {"ph": "X", "name": "serve.request", "cat": "serve",
+         "ts": 2_000_000, "dur": 1_000_000,
+         "args": {"request_id": "r1", "cold_buckets": 0, "warm_hits": 4,
+                  "issues": 1}},
+        # inside r1's window: attributed to its per-phase breakdown
+        {"ph": "X", "name": "svm.tx", "cat": "svm", "ts": 2_100_000,
+         "dur": 800_000},
+        # outside every request window: not attributed
+        {"ph": "X", "name": "svm.tx", "cat": "svm", "ts": 3_500_000,
+         "dur": 100_000},
+    ]
+    text = report(events, {})
+    assert "== serve (warmup vs requests) ==" in text
+    assert "warmup: 2.00s — 2/3 manifest bucket(s) warmed, 1 unwarmable" \
+        in text
+    assert "request r1: 1.00s  cold_buckets=0 warm_hits=4 issues=1" in text
+    # breakdown shows the inner 800ms svm span only (80% of the window)
+    assert "[ 80.0%] svm          total   800.0ms  x1" in text
+
+
 def test_fmt_us_adaptive_units():
     assert _fmt_us(500) == "500us"
     assert _fmt_us(1500) == "1.5ms"

@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# One-shot pre-merge gate: tpu-lint, then the tier-1 suite.
+# One-shot pre-merge gate: tpu-lint, the serve smoke, then the tier-1
+# suite.
 #
 #     tools/check.sh            # lint + tier-1 (the ROADMAP "Tier-1 verify")
 #     tools/check.sh --lint     # lint only (fast pre-commit)
@@ -17,6 +18,11 @@ python -m tools.lint || exit $?
 if [ "${1:-}" = "--lint" ]; then
     exit 0
 fi
+
+echo
+echo "== serve smoke (daemon start -> request -> clean shutdown) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu \
+    python -m tools.serve_smoke || exit $?
 
 echo
 echo "== tier-1 (pytest, not slow, 870s budget) =="

@@ -1,0 +1,101 @@
+"""Serve-daemon smoke for the pre-merge gate (tools/check.sh).
+
+Full process-level lifecycle, CPU-only and CDCL-only so it stays cheap:
+
+1. start `myth-tpu serve` (unix-socket mode, warmup on over an empty
+   manifest) as a subprocess;
+2. wait for the socket, then send ping + one analyze request for the
+   mini killable contract + shutdown over one client connection;
+3. require the analyze reply to find the SELFDESTRUCT issue and the
+   daemon to exit 0 after the drain.
+
+Prints ``SERVE_SMOKE=ok`` on success; any failure exits non-zero with a
+diagnostic. The caller bounds the wall clock (check.sh wraps this in
+`timeout`)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mini_contract() -> str:
+    from mythril_tpu.frontends.asm import (assemble, creation_wrapper,
+                                           dispatcher)
+
+    runtime = assemble(dispatcher({
+        "activatekillability()": "PUSH1 0x01\nPUSH1 0x00\nSSTORE\nSTOP",
+        "commencekilling()": ("PUSH1 0x00\nSLOAD\nPUSH1 0x01\nEQ\n"
+                              "PUSH @do_kill\nJUMPI\nSTOP\n"
+                              "do_kill:\nJUMPDEST\nCALLER\nSELFDESTRUCT"),
+    }))
+    return creation_wrapper(runtime).hex()
+
+
+def main() -> int:
+    from mythril_tpu.serve import client
+
+    workdir = tempfile.mkdtemp(prefix="serve_smoke_")
+    socket_path = os.path.join(workdir, "serve.sock")
+    manifest_path = os.path.join(workdir, "warmset.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "mythril_tpu.interfaces.cli", "serve",
+         "--socket", socket_path, "--manifest", manifest_path],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    try:
+        deadline = time.monotonic() + 90
+        while not os.path.exists(socket_path):
+            if daemon.poll() is not None:
+                print("serve_smoke: daemon died before binding:\n"
+                      + daemon.stderr.read().decode(errors="replace"),
+                      file=sys.stderr)
+                return 1
+            if time.monotonic() > deadline:
+                print("serve_smoke: socket never appeared", file=sys.stderr)
+                return 1
+            time.sleep(0.2)
+
+        replies = client.roundtrip(
+            [{"op": "ping", "id": "smoke-ping"},
+             {"op": "analyze", "id": "smoke-analyze",
+              "code": _mini_contract(), "transaction_count": 2,
+              "deadline_ms": 120_000},
+             {"op": "shutdown", "id": "smoke-shutdown"}],
+            socket_path=socket_path, timeout=120)
+
+        problems = []
+        if not all(reply.get("ok") for reply in replies):
+            problems.append(f"non-ok reply: {replies}")
+        analyze = replies[1]
+        if analyze.get("issue_count", 0) < 1:
+            problems.append(f"expected >=1 issue, got {analyze}")
+        if "warm" not in analyze:
+            problems.append(f"no warm/cold accounting in {analyze}")
+        daemon.wait(timeout=30)
+        if daemon.returncode != 0:
+            problems.append(f"daemon exited {daemon.returncode}:\n"
+                            + daemon.stderr.read().decode(errors="replace"))
+        if not os.path.exists(manifest_path) and analyze.get("warm", {}) \
+                .get("cold_buckets"):
+            problems.append("compiled buckets but wrote no manifest")
+        if problems:
+            print("serve_smoke: FAIL\n" + "\n".join(problems),
+                  file=sys.stderr)
+            return 1
+        print(f"SERVE_SMOKE=ok issues={analyze['issue_count']} "
+              f"elapsed_ms={analyze.get('elapsed_ms')}")
+        return 0
+    finally:
+        if daemon.poll() is None:
+            daemon.kill()
+            daemon.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,11 @@ Reads a Chrome ``trace_event`` JSON written by the observe span tracer
   SolverStatistics.batch_metrics;
 * XLA compile accounting: every ``xla.compile`` span with its
   clause-shape key and cost — the per-shape compile cliff that the pow2
-  bucketing exists to bound.
+  bucketing exists to bound;
+* serve rollup (traces from `myth-tpu serve` only): warmup attributed
+  separately from request time, then request id -> duration, warm vs
+  cold dispatch counts, and the per-phase breakdown inside each request
+  window.
 
 Stdlib-only (json/argparse/math): usable on a workstation without jax.
 Exit codes: 0 on success, 2 when the file is missing or not a valid
@@ -203,6 +207,8 @@ def report(events: List[dict], other: Dict[str, object]) -> str:
     else:
         lines.append("  (no xla.compile spans — every bucket was warm)")
 
+    lines.extend(_serve_section(spans))
+
     if instants:
         lines.append("")
         lines.append("== instant events ==")
@@ -213,6 +219,52 @@ def report(events: List[dict], other: Dict[str, object]) -> str:
                          f"{event['name']}" + (f"  ({detail})" if detail
                                                else ""))
     return "\n".join(lines)
+
+
+def _serve_section(spans: List[dict]) -> List[str]:
+    """Serve-daemon rollup: warmup attributed separately from request
+    time, then one line per request (id, duration, warm vs cold dispatch
+    counts) with its per-phase breakdown — spans that ran inside the
+    request window, grouped by category. Empty (section omitted) for
+    traces without serve spans, so non-serve reports are unchanged."""
+    warmups = [s for s in spans if s["name"] == "serve.warmup"]
+    requests = [s for s in spans if s["name"] == "serve.request"]
+    if not warmups and not requests:
+        return []
+    lines = ["", "== serve (warmup vs requests) =="]
+    for span in warmups:
+        args = span.get("args", {})
+        line = (f"  warmup: {_fmt_us(float(span.get('dur', 0.0)))} — "
+                f"{args.get('warmed', '?')}/{args.get('buckets', '?')} "
+                f"manifest bucket(s) warmed")
+        if args.get("failed"):
+            line += f", {args['failed']} unwarmable"
+        lines.append(line)
+    if not warmups:
+        lines.append("  (no warmup span — daemon started with warmup off)")
+    for request in sorted(requests, key=lambda s: float(s.get("ts", 0.0))):
+        args = request.get("args", {})
+        start = float(request.get("ts", 0.0))
+        dur = float(request.get("dur", 0.0))
+        lines.append(
+            f"  request {args.get('request_id', '?')}: {_fmt_us(dur)}  "
+            f"cold_buckets={args.get('cold_buckets', '?')} "
+            f"warm_hits={args.get('warm_hits', '?')} "
+            f"issues={args.get('issues', '?')}")
+        inner = [
+            s for s in spans
+            if s is not request and not s["name"].startswith("serve.")
+            and start <= float(s.get("ts", 0.0))
+            and (float(s.get("ts", 0.0)) + float(s.get("dur", 0.0))
+                 <= start + dur)]
+        for row in rollup(inner, lambda s: s.get("cat")
+                          or s["name"].split(".", 1)[0]):
+            share = row["total_us"] / dur * 100 if dur else 0.0
+            lines.append(
+                f"    [{share:5.1f}%] {row['name']:<12} "
+                f"total {_fmt_us(row['total_us']):>9}  "
+                f"x{row['count']:<6} mean {_fmt_us(row['mean_us']):>9}")
+    return lines
 
 
 def main(argv: Optional[List[str]] = None) -> int:
