@@ -6,7 +6,7 @@ from __future__ import annotations
 
 import logging
 from enum import Enum
-from typing import List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from ...core.state.global_state import GlobalState
 from ...support.support_args import args
@@ -31,6 +31,17 @@ class DetectionModule:
     entry_point = EntryPoint.CALLBACK
     pre_hooks: List[str] = []
     post_hooks: List[str] = []
+    #: sink declaration for the taint module screen
+    #: (analysis/module_screen.py): hooked opcode -> operand indices
+    #: (0 = top of stack at the hook site) whose untaintedness makes an
+    #: issue impossible there. An EMPTY tuple is a presence-only sink:
+    #: it documents what the module sinks on but opts out of site-level
+    #: screening (the module can flag sites with deterministic operands
+    #: too, so skipping on "untainted" would change detections). Only
+    #: declare operand indices when `every operand untainted (i.e. a
+    #: deterministic function of the bytecode) => _execute returns no
+    #: issue` provably holds.
+    taint_sinks: Dict[str, Tuple[int, ...]] = {}
 
     def __init__(self):
         self.issues: List[Issue] = []

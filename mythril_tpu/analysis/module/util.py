@@ -7,6 +7,7 @@ import logging
 from collections import defaultdict
 from typing import Callable, Dict, List
 
+from .. import module_screen
 from .base import DetectionModule, EntryPoint
 from .loader import ModuleLoader
 
@@ -19,9 +20,20 @@ def get_detection_module_hooks(modules: List[DetectionModule],
     for module in modules:
         hooks = module.pre_hooks if hook_type == "pre" else module.post_hooks
         for op_code in hooks:
-            def hook_wrapper(module_reference=module):
-                def hook(global_state):
-                    module_reference.execute(global_state)
+            def hook_wrapper(module_reference=module, op=op_code):
+                if hook_type == "pre":
+                    # the taint module screen can prove some sites
+                    # issue-free (untainted sink operands) before any
+                    # solver query; post hooks fire after the op, where
+                    # the summary's site pc no longer lines up
+                    def hook(global_state):
+                        if module_screen.should_skip_site(
+                                module_reference, op, global_state):
+                            return
+                        module_reference.execute(global_state)
+                else:
+                    def hook(global_state):
+                        module_reference.execute(global_state)
 
                 return hook
 

@@ -25,6 +25,11 @@ class ArbitraryJump(DetectionModule):
     description = "Check for jumps to arbitrary locations in the bytecode"
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMP", "JUMPI"]
+    # an untainted dest is a deterministic function of the bytecode: on a
+    # per-path engine it reaches the hook as a concrete value, and the
+    # is_const early-return above fires — skipping the hook is
+    # detection-identical, so operand-level screening is sound here
+    taint_sinks = {"JUMP": (0,), "JUMPI": (0,)}
 
     def _execute(self, state: GlobalState):
         jump_dest = state.mstate.stack[-1]

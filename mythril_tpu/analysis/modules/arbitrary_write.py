@@ -21,6 +21,10 @@ class ArbitraryStorage(DetectionModule):
     description = "Check for writes to arbitrary storage locations"
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["SSTORE"]
+    # presence-only: a deterministic slot equal to the probe constant
+    # would still satisfy `write_slot == probe`, so skipping untainted
+    # sites could drop a PotentialIssue the unscreened run reports
+    taint_sinks = {"SSTORE": ()}
 
     def _execute(self, state: GlobalState):
         write_slot = state.mstate.stack[-1]

@@ -23,6 +23,9 @@ class ArbitraryDelegateCall(DetectionModule):
     description = "Check for invocations of delegatecall to a user-supplied address."
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["DELEGATECALL"]
+    # presence-only: a deterministic `to` equal to the attacker actor
+    # address would still satisfy the module's constraints
+    taint_sinks = {"DELEGATECALL": ()}
 
     def _execute(self, state: GlobalState):
         gas = state.mstate.stack[-1]

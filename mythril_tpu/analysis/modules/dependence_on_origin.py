@@ -29,6 +29,7 @@ class TxOrigin(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMPI"]
     post_hooks = ["ORIGIN"]
+    taint_sinks = {"ORIGIN": ()}
 
     def _execute(self, state: GlobalState):
         instruction = state.get_current_instruction()

@@ -48,6 +48,7 @@ class PredictableVariables(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMPI", "BLOCKHASH"]
     post_hooks = PREDICTABLE_OPS + ["BLOCKHASH"]
+    taint_sinks = {"BLOCKHASH": (), "JUMPI": ()}
 
     def _execute(self, state: GlobalState):
         instruction = state.get_current_instruction()

@@ -27,6 +27,7 @@ class EtherPhishing(DetectionModule):
                    "the attacker.")
     entry_point = EntryPoint.CALLBACK
     post_hooks = ["CALL"]
+    taint_sinks = {"CALL": ()}
 
     def _execute(self, state: GlobalState):
         world_state = state.world_state

@@ -25,6 +25,7 @@ class EtherThief(DetectionModule):
                    "user-specified address.")
     entry_point = EntryPoint.CALLBACK
     post_hooks = ["CALL", "STATICCALL"]
+    taint_sinks = {"CALL": (), "STATICCALL": ()}
 
     def _execute(self, state: GlobalState):
         # runs right after the CALL's post handler: inspect the completed

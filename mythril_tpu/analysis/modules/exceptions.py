@@ -63,6 +63,9 @@ class Exceptions(DetectionModule):
                   "or Panic(1) revert)."
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["INVALID", "JUMP", "REVERT"]
+    # presence-only: a constant invalid JUMP dest is a real assert-style
+    # finding, so untainted sites must still run the hook
+    taint_sinks = {"INVALID": (), "JUMP": ()}
 
     def __init__(self):
         super().__init__()

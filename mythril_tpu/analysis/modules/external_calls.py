@@ -26,6 +26,7 @@ class ExternalCalls(DetectionModule):
                    "callee to re-enter (reference external_calls.py).")
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["CALL"]
+    taint_sinks = {"CALL": ()}
 
     def _execute(self, state: GlobalState):
         if getattr(state.environment, "active_function_name",

@@ -74,6 +74,7 @@ class IntegerArithmetics(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["ADD", "SUB", "MUL", "EXP", "SSTORE", "JUMPI", "CALL",
                  "RETURN", "STOP"]
+    taint_sinks = {"ADD": (), "SUB": (), "MUL": (), "EXP": ()}
 
     def __init__(self):
         super().__init__()

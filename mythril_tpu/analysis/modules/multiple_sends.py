@@ -34,6 +34,8 @@ class MultipleSends(DetectionModule):
     description = "Check for multiple sends in a single transaction"
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE", "RETURN", "STOP"]
+    taint_sinks = {"CALL": (), "DELEGATECALL": (), "STATICCALL": (),
+                   "CALLCODE": ()}
 
     def _execute(self, state: GlobalState):
         annotations = list(state.get_annotations(MultipleSendsAnnotation))

@@ -24,6 +24,7 @@ class RequirementsViolation(DetectionModule):
                    "require() over caller-provided inputs.")
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["REVERT"]
+    taint_sinks = {"REVERT": ()}
 
     def _execute(self, state: GlobalState):
         # only reverts inside a NESTED frame qualify (the calling contract

@@ -96,6 +96,8 @@ class StateChangeAfterCall(DetectionModule):
                    "external call to a user-defined address.")
     entry_point = EntryPoint.CALLBACK
     pre_hooks = CALL_LIST + STATE_READ_WRITE_LIST
+    taint_sinks = {"CALL": (), "DELEGATECALL": (), "CALLCODE": (),
+                   "SSTORE": ()}
 
     def _execute(self, state: GlobalState):
         if getattr(state.environment, "active_function_name",

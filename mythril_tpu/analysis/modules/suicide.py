@@ -28,6 +28,7 @@ class AccidentallyKillable(DetectionModule):
                    "direct the contract balance to the attacker.")
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["SELFDESTRUCT"]
+    taint_sinks = {"SELFDESTRUCT": ()}
 
     def _execute(self, state: GlobalState):
         instruction = state.get_current_instruction()

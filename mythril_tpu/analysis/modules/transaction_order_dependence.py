@@ -35,6 +35,7 @@ class TxOrderDependence(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["CALL"]
     post_hooks = ["BALANCE", "SLOAD"]
+    taint_sinks = {"CALL": ()}
 
     @staticmethod
     def _annotate_read(state: GlobalState, opcode: str):

@@ -38,6 +38,8 @@ class UncheckedRetval(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["STOP", "RETURN"]
     post_hooks = ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE"]
+    taint_sinks = {"CALL": (), "DELEGATECALL": (), "STATICCALL": (),
+                   "CALLCODE": ()}
 
     def _execute(self, state: GlobalState):
         instruction = state.get_current_instruction()

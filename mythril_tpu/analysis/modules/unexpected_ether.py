@@ -30,6 +30,7 @@ class UnexpectedEther(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMPI"]
     post_hooks = ["BALANCE", "SELFBALANCE"]
+    taint_sinks = {"BALANCE": (), "SELFBALANCE": ()}
 
     def _execute(self, state: GlobalState):
         instruction = state.get_current_instruction()

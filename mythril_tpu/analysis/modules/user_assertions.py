@@ -49,6 +49,7 @@ class UserAssertions(DetectionModule):
                    "emit AssertionFailed(string).")
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["LOG1", "MSTORE"]
+    taint_sinks = {"LOG1": (), "MSTORE": ()}
 
     def _execute(self, state: GlobalState):
         opcode = state.get_current_instruction()["opcode"]

@@ -25,6 +25,7 @@ from ..core.state.world_state import WorldState
 from ..core.transaction.transaction_models import tx_id_manager
 from ..smt import BitVec, symbol_factory
 from ..support.support_args import args
+from . import module_screen
 from .module import ModuleLoader, get_detection_module_hooks
 from .module.base import EntryPoint
 from .ops import Call, VarType, get_variable
@@ -125,9 +126,42 @@ class SymExecWrapper:
 
         self.plugin_loader = plugin_loader
 
+        # runtime-code analysis builds its world state up front: the taint
+        # module screen needs the contract's disassembly before hooks are
+        # registered
+        creation_mode = isinstance(contract, str) or (
+            hasattr(contract, "creation_code") and contract.creation_code
+            and getattr(contract, "name", None))
+        world_state = account = None
+        if not creation_mode:
+            world_state = WorldState()
+            account = world_state.create_account(
+                balance=10 ** 18,
+                address=address.value if address is not None else None,
+                concrete_storage=False, dynamic_loader=dynloader)
+            if hasattr(contract, "disassembly"):
+                account.code = contract.disassembly
+            else:
+                from ..frontends.disassembler import Disassembly
+
+                account.code = Disassembly(
+                    contract.code if hasattr(contract, "code") else contract)
+            account.contract_name = getattr(contract, "name", "Unknown")
+
         if run_analysis_modules:
             analysis_modules = ModuleLoader().get_detection_modules(
                 EntryPoint.CALLBACK, white_list=modules)
+            if account is not None and dynloader is None:
+                # creation transactions and dynamically loaded code run
+                # hooks over bytecode the summary never saw, so the
+                # whole-module screen only applies to pure runtime runs
+                analysis_modules, skipped = module_screen.screen_modules(
+                    analysis_modules, account.code)
+                if skipped:
+                    log.info(
+                        "module screen: %d module(s) skipped — no "
+                        "reachable hook opcode: %s", len(skipped),
+                        ", ".join(type(m).__name__ for m in skipped))
             self.laser.register_hooks(
                 hook_type="pre",
                 hook_dict=get_detection_module_hooks(analysis_modules,
@@ -153,17 +187,7 @@ class SymExecWrapper:
             self.laser.sym_exec(creation_code=contract.creation_code,
                                 contract_name=contract.name)
         else:
-            # runtime-code analysis on a fresh world state
-            world_state = WorldState()
-            account = world_state.create_account(
-                balance=10 ** 18,
-                address=address.value if address is not None else None,
-                concrete_storage=False, dynamic_loader=dynloader)
-            from ..frontends.disassembler import Disassembly
-
-            account.code = Disassembly(contract.code if hasattr(contract, "code")
-                                       else contract)
-            account.contract_name = getattr(contract, "name", "Unknown")
+            # runtime-code analysis on the world state prepared above
             self.laser.sym_exec(world_state=world_state,
                                 target_address=account.address.value)
 

@@ -34,7 +34,12 @@ class EVMContract:
 
     @property
     def disassembly(self) -> Disassembly:
-        return Disassembly(self.code)
+        # cached: per-contract static analyses (cfa, taint summary) memoize
+        # on the Disassembly instance, and the serve daemon pre-seeds
+        # persisted summaries onto it before the engine runs
+        if getattr(self, "_disassembly", None) is None:
+            self._disassembly = Disassembly(self.code)
+        return self._disassembly
 
     @property
     def creation_disassembly(self) -> Disassembly:

@@ -76,6 +76,11 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
                               "screen (staticanalysis/): jump validity, "
                               "merge-point tagging, and dead-code pruning "
                               "fall back to dynamic checks (A/B measurement)")
+    options.add_argument("--no-taint", action="store_true",
+                         help="disable the taint module screen "
+                              "(staticanalysis/taint.py): detection "
+                              "modules register and fire on every hook "
+                              "site again (A/B measurement)")
     options.add_argument("--engine", default="host", choices=["host", "tpu"],
                          help="exploration engine: host worklist or the "
                               "batched TPU symbolic frontier")

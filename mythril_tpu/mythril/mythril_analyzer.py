@@ -62,6 +62,7 @@ class MythrilAnalyzer:
         args.simplify = not getattr(cmd, "no_simplify", False)
         args.batch_solve = not getattr(cmd, "no_batch_solve", False)
         args.cfa = not getattr(cmd, "no_cfa", False)
+        args.taint = not getattr(cmd, "no_taint", False)
         args.device_crosscheck = getattr(cmd, "device_crosscheck", 0)
         args.inject_fault = getattr(cmd, "inject_fault", None)
         solver = getattr(cmd, "solver", None)

@@ -149,6 +149,22 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("cfa.frontier.prefetch_skipped", COUNTER, "1",
                "Feasibility prefetches skipped for statically dead or "
                "invalid target pcs."),
+    # -- source->sink taint analysis (staticanalysis/taint.py) -------------------
+    MetricSpec("taint.functions", COUNTER, "1",
+               "Public functions recovered from the dispatcher idiom by "
+               "taint-summary builds (fallback partition included)."),
+    MetricSpec("taint.loops", COUNTER, "1",
+               "Natural loops (back edges over the dominator tree) found "
+               "by taint-summary builds."),
+    MetricSpec("taint.screen.modules_skipped", COUNTER, "1",
+               "Detection modules skipped wholesale because none of "
+               "their hook opcodes appear in reachable code."),
+    MetricSpec("taint.screen.sites_skipped", COUNTER, "1",
+               "Pre-hook firings skipped because the summary proves the "
+               "module's sink operands untainted at that pc."),
+    MetricSpec("taint.frontier.loop_tagged", COUNTER, "1",
+               "Materialized device lanes tagged with the natural-loop "
+               "header their pc sits inside (bounded-unroll budgeting)."),
     # -- analysis service (mythril_tpu/serve/) -----------------------------------
     MetricSpec("serve.requests", COUNTER, "1",
                "Requests the analysis service finished (ok or error)."),
@@ -161,6 +177,10 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("serve.warmed_buckets", COUNTER, "1",
                "Clause-shape buckets pre-compiled by the AOT warmup "
                "phase at daemon startup."),
+    MetricSpec("serve.summary_seeded", COUNTER, "1",
+               "Analysis requests whose contract taint summary was "
+               "pre-seeded from the warmset summary store instead of "
+               "rebuilt."),
     MetricSpec("serve.request_ms", HISTOGRAM, "ms",
                "Wall time of one analysis request, warmup excluded."),
     # -- engine plugins (core/plugin/plugins/) -----------------------------------

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import module_screen
 from ..core.state.annotation import StateAnnotation
 from ..core.state.global_state import GlobalState
 from ..exceptions import UnsatError
@@ -292,6 +293,10 @@ class LaneContext(A.TxContext):
     def __init__(self, tx_id: str, calldata, environment, template: GlobalState):
         super().__init__(tx_id, calldata, environment)
         self.template = template
+        #: dispatcher-order function entry pcs from the taint summary
+        #: (module_screen.function_order): fleet scheduling groups this
+        #: contract's lanes per function from here (ROADMAP item 2)
+        self.function_order: Tuple[int, ...] = ()
 
 
 class MergeTagAnnotation(StateAnnotation):
@@ -306,6 +311,20 @@ class MergeTagAnnotation(StateAnnotation):
 
     def __copy__(self):
         return MergeTagAnnotation(self.merge_pc)
+
+
+class LoopHintAnnotation(StateAnnotation):
+    """Rides on materialized lanes whose pc sits inside a natural loop
+    (taint summary's per-loop-header hint tables): the bounded-unroll
+    budgeter groups lanes by header pc to cap per-loop lane spend."""
+
+    __slots__ = ("header_pc",)
+
+    def __init__(self, header_pc: int):
+        self.header_pc = header_pc
+
+    def __copy__(self):
+        return LoopHintAnnotation(self.header_pc)
 
 
 def _storage_entries(storage) -> Tuple[List[Tuple[int, object]], bool]:
@@ -479,6 +498,11 @@ class _Frontier:
             # build the CFA tables now, outside the step loop: every
             # materialized lane of this contract reads them
             cfa_screen.warm(template.environment.code)
+            # same for the taint summary; the lane context carries the
+            # dispatcher function order for per-function lane grouping
+            module_screen.warm(template.environment.code)
+            ctx.function_order = module_screen.function_order(
+                template.environment.code)
             self.contexts.append(ctx)
             ctx_id[lane] = len(self.contexts) - 1
             # symbolic storage values ride in as host-term leaves
@@ -1331,6 +1355,14 @@ class _Frontier:
         if merge_pc is not None:
             global_state.annotate(MergeTagAnnotation(merge_pc))
             metrics.inc("cfa.frontier.merge_tagged")
+
+        # loop tagging: lanes inside a natural loop carry the innermost
+        # header pc, so bounded-unroll budgeting can cap lane spend per
+        # loop instead of per contract
+        loop_header = module_screen.loop_header_at(disassembly, byte_pc)
+        if loop_header is not None:
+            global_state.annotate(LoopHintAnnotation(loop_header))
+            metrics.inc("taint.frontier.loop_tagged")
 
         # gas accounting (device tracks the lower-bound model)
         gas_used = int(state_np["gas_used"][lane])

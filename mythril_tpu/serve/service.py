@@ -195,8 +195,9 @@ class AnalysisService:
         else:
             cmd.execution_timeout = 86400
         disassembler = MythrilDisassembler()
-        address, _ = disassembler.load_from_bytecode(
+        address, contract = disassembler.load_from_bytecode(
             params["code"], params["bin_runtime"])
+        self._seed_summary(contract)
         analyzer = MythrilAnalyzer(
             disassembler, cmd_args=cmd,
             strategy=params.get("strategy") or self.strategy,
@@ -204,9 +205,40 @@ class AnalysisService:
         report = analyzer.fire_lasers(
             modules=params.get("modules"),
             transaction_count=params["transaction_count"])
+        self._record_summary(contract)
         return {
             "issue_count": len(report.issues),
             "incomplete": bool(getattr(report, "incomplete", False)),
             "coverage": getattr(report, "coverage", {}) or {},
             "report": json.loads(report.as_json()),
         }
+
+    def _seed_summary(self, contract) -> None:
+        """Pre-seed a persisted taint summary onto the contract's
+        disassembly so a repeat corpus contract skips the fixpoint.
+        Runtime code only — creation requests execute constructor code
+        the summary never modeled."""
+        if not getattr(contract, "code", None):
+            return
+        from ..staticanalysis import ContractSummary, install_summary
+
+        cached = self.warmset.summary_for(contract.bytecode_hash)
+        if cached is None:
+            return
+        summary = ContractSummary.from_json(cached)
+        if summary is not None and summary.code_length * 2 == len(
+                contract.code.removeprefix("0x")):
+            install_summary(contract.disassembly, summary)
+            metrics.inc("serve.summary_seeded")
+
+    def _record_summary(self, contract) -> None:
+        """Queue this contract's summary (fresh or seeded) for the
+        warmset's summary store; flushed with the shape manifest."""
+        if not getattr(contract, "code", None):
+            return
+        from ..staticanalysis import get_summary
+
+        summary = get_summary(contract.disassembly)
+        if summary is not None:
+            self.warmset.record_summary(contract.bytecode_hash,
+                                        summary.to_json())

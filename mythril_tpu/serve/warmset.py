@@ -32,6 +32,16 @@ manifest only ever grows its warm set. Writes go through the fsync-atomic
 leaves a torn manifest behind. Unknown versions and malformed entries
 load as empty/skipped — a stale manifest degrades to a cold start, never
 a crash.
+
+Beside the shape manifest lives the **taint-summary store**
+(``<manifest>.summaries.json``): per-contract
+``staticanalysis.ContractSummary`` JSON keyed by runtime-bytecode hash.
+A warm daemon seeing a repeat corpus contract pre-seeds the persisted
+summary onto its disassembly (``staticanalysis.install_summary``) before
+the engine runs, so the taint fixpoint — like the XLA compiles — is paid
+once per contract, not once per request. The store follows the same
+rules as the manifest: monotone union-merge on save, fsync-atomic
+writes, and tolerant loads that degrade to "rebuild the summary".
 """
 
 from __future__ import annotations
@@ -39,7 +49,7 @@ from __future__ import annotations
 import json
 import logging
 import os
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..observe import metrics, trace
 from ..support import tpu_config
@@ -48,6 +58,7 @@ from ..support.checkpoint import fsync_replace
 log = logging.getLogger(__name__)
 
 MANIFEST_VERSION = 1
+SUMMARIES_VERSION = 1
 
 
 def default_manifest_path() -> str:
@@ -105,6 +116,59 @@ def save_manifest(path: str, shapes: List[Tuple]) -> int:
     return len(merged)
 
 
+def summaries_path_for(manifest_path: str) -> str:
+    """The taint-summary store sits beside the shape manifest:
+    ``warmset.json`` → ``warmset.summaries.json``."""
+    base, _ = os.path.splitext(manifest_path)
+    return f"{base}.summaries.json"
+
+
+def load_summaries(path: str) -> Dict[str, dict]:
+    """Per-contract summary JSON keyed by bytecode hash; {} for missing,
+    malformed, or unknown-version stores (logged, never raised). Entries
+    are returned verbatim — ``ContractSummary.from_json`` does its own
+    version/shape validation at install time."""
+    try:
+        with open(path, "r", encoding="utf-8") as handle:
+            doc = json.load(handle)
+    except FileNotFoundError:
+        return {}
+    except (OSError, ValueError) as error:
+        log.warning("summary store %s unreadable (%s) — summaries will "
+                    "be rebuilt", path, error)
+        return {}
+    if not isinstance(doc, dict) or doc.get("version") != SUMMARIES_VERSION:
+        log.warning("summary store %s has unsupported version %r — "
+                    "summaries will be rebuilt", path,
+                    doc.get("version") if isinstance(doc, dict) else None)
+        return {}
+    summaries = {}
+    for key, entry in (doc.get("summaries") or {}).items():
+        if isinstance(key, str) and isinstance(entry, dict):
+            summaries[key] = entry
+        else:
+            log.warning("summary store %s: skipping malformed entry %r",
+                        path, key)
+    return summaries
+
+
+def save_summaries(path: str, summaries: Dict[str, dict]) -> int:
+    """Merge `summaries` into the store at `path` (union by bytecode
+    hash, this process's entries winning ties) and write it
+    fsync-atomically. Returns the merged entry count."""
+    merged = load_summaries(path)
+    merged.update({k: v for k, v in summaries.items()
+                   if isinstance(k, str) and isinstance(v, dict)})
+    payload = {"version": SUMMARIES_VERSION,
+               "summaries": {key: merged[key] for key in sorted(merged)}}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as handle:
+        json.dump(payload, handle, indent=1)
+    fsync_replace(tmp, path)
+    return len(merged)
+
+
 class WarmSet:
     """The daemon's view of the warm buckets: load → warm → record.
 
@@ -115,6 +179,29 @@ class WarmSet:
         self.path = path
         self.warmed: List[Tuple] = []
         self.failed: List[Tuple] = []
+        # taint summaries recorded this process, pending persistence
+        self._pending_summaries: Dict[str, dict] = {}
+        # lazy-loaded view of the on-disk store (None = not loaded yet)
+        self._stored_summaries: Optional[Dict[str, dict]] = None
+
+    def _summaries_path(self) -> Optional[str]:
+        return summaries_path_for(self.path) if self.path else None
+
+    def summary_for(self, code_hash: str) -> Optional[dict]:
+        """The persisted ContractSummary JSON for a bytecode hash, if any
+        (this process's fresh records take precedence over disk)."""
+        if code_hash in self._pending_summaries:
+            return self._pending_summaries[code_hash]
+        if self._stored_summaries is None:
+            path = self._summaries_path()
+            self._stored_summaries = load_summaries(path) if path else {}
+        return self._stored_summaries.get(code_hash)
+
+    def record_summary(self, code_hash: str, summary_json: dict) -> None:
+        """Queue a freshly built summary for persistence (flushed by
+        :meth:`record_observed` after each request and at shutdown)."""
+        if code_hash and isinstance(summary_json, dict):
+            self._pending_summaries[code_hash] = summary_json
 
     def warmup(self) -> int:
         """Pre-compile every manifest bucket, inside one ``serve.warmup``
@@ -150,6 +237,7 @@ class WarmSet:
         warm. No-op (returning 0) without a manifest path."""
         if not self.path:
             return 0
+        self._flush_summaries()
         from ..parallel import jax_solver
 
         observed = jax_solver.observed_shape_keys()
@@ -162,12 +250,33 @@ class WarmSet:
                         self.path, error)
             return 0
 
+    def _flush_summaries(self) -> None:
+        if not self._pending_summaries:
+            return
+        path = self._summaries_path()
+        try:
+            save_summaries(path, self._pending_summaries)
+        except OSError as error:
+            log.warning("could not persist summary store %s: %s",
+                        path, error)
+            return
+        # fold into the in-memory view so summary_for keeps answering
+        # without a re-read, then clear the queue
+        if self._stored_summaries is not None:
+            self._stored_summaries.update(self._pending_summaries)
+        self._pending_summaries.clear()
+
     def status(self) -> dict:
         from ..parallel import jax_solver
 
+        if self._stored_summaries is None:
+            path = self._summaries_path()
+            self._stored_summaries = load_summaries(path) if path else {}
         return {
             "manifest": self.path,
             "warmed_buckets": len(self.warmed),
             "unwarmable_buckets": len(self.failed),
             "observed_buckets": len(jax_solver.observed_shape_keys()),
+            "taint_summaries": len(set(self._stored_summaries)
+                                   | set(self._pending_summaries)),
         }

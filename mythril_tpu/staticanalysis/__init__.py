@@ -9,6 +9,11 @@ merging (ROADMAP item 3) consume.
 Entry point for consumers: :func:`get_cfa` — memoized per Disassembly,
 returns None when analysis is disabled or bails (over the block budget),
 in which case callers keep their dynamic paths.
+
+On top of the cfa tables, :mod:`.taint` + :mod:`.summary` add a
+source->sink taint dataflow, selector/function partitioning, and
+natural-loop hint tables; :func:`get_summary` is the memoized entry
+point with the same None-means-no-verdict contract.
 """
 
 from __future__ import annotations
@@ -17,16 +22,27 @@ from typing import Optional
 
 from .cfa import BasicBlock, CfaResult, TERMINATORS, build_cfa
 from .domtree import compute_idoms, dominator_depth, postorder
+from .summary import ContractSummary, FunctionInfo, LoopInfo, build_summary
+from .taint import SinkSite, TaintResult, build_taint
 
 __all__ = [
     "BasicBlock",
     "CfaResult",
+    "ContractSummary",
+    "FunctionInfo",
+    "LoopInfo",
+    "SinkSite",
     "TERMINATORS",
+    "TaintResult",
     "build_cfa",
+    "build_summary",
+    "build_taint",
     "compute_idoms",
     "dominator_depth",
-    "postorder",
     "get_cfa",
+    "get_summary",
+    "install_summary",
+    "postorder",
 ]
 
 _MISS = object()  # memo sentinel: distinguishes "not built" from "bailed"
@@ -71,3 +87,51 @@ def get_cfa(disassembly) -> Optional[CfaResult]:
             metrics.inc("cfa.dead_bytes", result.dead_bytes)
     disassembly._cfa_result = result
     return result
+
+
+def get_summary(disassembly) -> Optional[ContractSummary]:
+    """Build (once) and return the taint/function/loop summary for a
+    Disassembly.
+
+    Memoized on the Disassembly instance (`_taint_summary`), like
+    :func:`get_cfa`. Returns None when MYTHRIL_TPU_TAINT is off, the cfa
+    tables are unavailable, or the taint fixpoint bailed — consumers
+    treat None as "no verdict" and keep their dynamic paths.
+    """
+    from ..observe import metrics, trace
+    from ..support import tpu_config
+
+    cached = getattr(disassembly, "_taint_summary", _MISS)
+    if cached is not _MISS:
+        return cached
+
+    if not tpu_config.get_flag("MYTHRIL_TPU_TAINT"):
+        disassembly._taint_summary = None
+        return None
+
+    cfa = get_cfa(disassembly)
+    if cfa is None:
+        disassembly._taint_summary = None
+        return None
+
+    with trace.span("taint.build") as span:
+        result = build_summary(disassembly, cfa)
+        if result is None:
+            span.set(bailed=True)
+        else:
+            span.set(
+                functions=len(result.functions),
+                loops=len(result.loops),
+                sinks=len(result.sink_sites),
+                rounds=result.rounds,
+            )
+            metrics.inc("taint.functions", len(result.functions))
+            metrics.inc("taint.loops", len(result.loops))
+    disassembly._taint_summary = result
+    return result
+
+
+def install_summary(disassembly, summary: Optional[ContractSummary]) -> None:
+    """Pre-seed the summary memo (serve warm path: summaries persisted by
+    code hash skip the rebuild on repeat contracts)."""
+    disassembly._taint_summary = summary

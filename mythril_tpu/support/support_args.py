@@ -48,6 +48,10 @@ class Args:
         #: smt/solver/cfa_screen.py); --no-cfa turns all consumers off
         #: for A/B measurement
         self.cfa = True
+        #: taint module screen (staticanalysis/taint.py +
+        #: analysis/module_screen.py); --no-taint turns all consumers
+        #: off for A/B measurement
+        self.taint = True
         self.sparse_pruning = True
         self.enable_state_merging = False
         self.enable_summaries = False

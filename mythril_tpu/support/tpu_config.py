@@ -133,6 +133,21 @@ _KNOBS: List[Knob] = [
     Knob("MYTHRIL_TPU_CFA_STACK_DEPTH", "int", 32,
          "Abstract-stack slots tracked per block entry by the cfa "
          "constant dataflow; deeper slots are treated as unknown."),
+    # -- source->sink taint analysis (mythril_tpu/staticanalysis/taint.py) --------
+    Knob("MYTHRIL_TPU_TAINT", "flag", True,
+         "Build per-contract taint summaries (function partition, loop "
+         "headers, source->sink taint verdicts) over the CFA tables and "
+         "let the module screen skip unreachable modules and untainted "
+         "hook sites; the --no-taint CLI flag also turns the consumers "
+         "off for A/B runs."),
+    Knob("MYTHRIL_TPU_TAINT_MAX_ITERS", "int", 4,
+         "Cross-transaction storage rounds of the taint fixpoint; at the "
+         "cap remaining storage cells saturate to fully-tainted so the "
+         "summary stays sound."),
+    Knob("MYTHRIL_TPU_TAINT_SLOTS", "int", 64,
+         "Concrete storage slots tracked per contract by the taint "
+         "dataflow; writes past the budget (or to unknown slots) collapse "
+         "into one conservative summary cell."),
     # -- test corpora -------------------------------------------------------------
     Knob("MYTHRIL_TPU_VMTESTS", "str", None,
          "Root of the ethereum/tests VMTests corpus for parity suites."),

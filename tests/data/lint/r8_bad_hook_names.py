@@ -1,0 +1,13 @@
+"""R8 bad fixture: hook lists naming opcodes that do not exist."""
+
+EXTRA_OPS = ["CALL", "BOGUSOP"]
+
+
+class MistypedHooks:
+    name = "mistyped hooks"
+    pre_hooks = ["JUMP", "NOTANOP"]
+    post_hooks = EXTRA_OPS + ["SSTORE"]
+    taint_sinks = {"JUMP": (), "CALL": (), "SSTORE": ()}
+
+    def _execute(self, state):
+        return []

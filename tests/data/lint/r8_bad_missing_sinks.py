@@ -1,0 +1,21 @@
+"""R8 bad fixture: hooked modules with missing or inconsistent
+taint_sinks tables."""
+
+
+class NoSinkTable:
+    name = "hooks without sinks"
+    pre_hooks = ["SSTORE"]
+
+    def _execute(self, state):
+        return []
+
+
+class StaleSinkTable:
+    name = "sink key outside the hook lists"
+    pre_hooks = ["CALL"]
+    # DELEGATECALL is never hooked -> dead entry; (0, "x") is not a
+    # tuple of ints
+    taint_sinks = {"DELEGATECALL": (), "CALL": (0, "x")}
+
+    def _execute(self, state):
+        return []

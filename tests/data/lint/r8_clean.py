@@ -1,0 +1,31 @@
+"""R8 clean fixture: well-formed hook lists (literal, named constant,
+and ``+``-concatenated) with a matching taint_sinks table."""
+
+CALL_OPS = ["CALL", "DELEGATECALL"]
+
+
+class WellFormedModule:
+    name = "well-formed module"
+    pre_hooks = CALL_OPS + ["SSTORE"]
+    post_hooks = ["CALL"]
+    taint_sinks = {"CALL": (), "DELEGATECALL": (0,), "SSTORE": (0, 1)}
+
+    def _execute(self, state):
+        return []
+
+
+class HooklessHelper:
+    """No hooks at all — the rule must not demand a sink table."""
+
+    name = "hookless helper"
+
+    def _execute(self, state):
+        return []
+
+
+class EmptyHookBase:
+    """Empty hook lists (the DetectionModule base shape)."""
+
+    pre_hooks = []
+    post_hooks = []
+    taint_sinks = {}

@@ -503,3 +503,32 @@ def test_cfaview_cli_rejects_garbage():
     proc = _cfaview("not-hex-not-a-file")
     assert proc.returncode == 2
     assert "cannot load" in proc.stderr
+
+
+def test_cfaview_cli_taint_sections_on_killbilly():
+    """Golden surface of `--taint` on the vendored killbilly: recovered
+    selectors, the SELFDESTRUCT sink verdict, and the module screen."""
+    proc = _cfaview("killbilly", "--taint")
+    assert proc.returncode == 0, proc.stderr
+    assert "== taint: functions ==" in proc.stdout
+    assert "activatekillability()" in proc.stdout
+    assert "commencekilling()" in proc.stdout
+    assert "== taint: natural loops ==" in proc.stdout
+    assert "SELFDESTRUCT" in proc.stdout
+    assert "[0]=caller" in proc.stdout
+    assert "== taint: module screen ==" in proc.stdout
+    assert "ExternalCalls" in proc.stdout  # no CALL in killbilly
+
+
+def test_cfaview_cli_taint_json_roundtrips():
+    proc = _cfaview("bectoken", "--taint", "--json")
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    taint = doc["taint"]
+    assert len(taint["functions"]) == 2
+    assert "AccidentallyKillable" in taint["screened_modules"]
+    from mythril_tpu.staticanalysis import ContractSummary
+
+    summary = ContractSummary.from_json(taint)
+    assert summary is not None
+    assert summary.n_sink_sites == len(taint["sink_sites"])

@@ -29,11 +29,11 @@ if REPO_ROOT not in sys.path:
 from tools.lint import (Baseline, LintContext, LintRule,  # noqa: E402
                         RuleDiscovery, Violation, run_lint)
 from tools.lint.rules import (dispatch_bypass, env_knobs,  # noqa: E402
-                              jump_resolution, metrics_registry,
-                              opcode_semantics, silent_excepts,
-                              trace_safety)
+                              hook_parity, jump_resolution,
+                              metrics_registry, opcode_semantics,
+                              silent_excepts, trace_safety)
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
 
 
 def _tree(text, filename="<fixture>"):
@@ -145,6 +145,11 @@ def _r7(name):
     return jump_resolution.check_file(name, _fixture_tree(name))
 
 
+def _r8(name):
+    return hook_parity.check_file(name, _fixture_tree(name),
+                                  hook_parity.load_opcode_names())
+
+
 @pytest.mark.parametrize("runner,fixture,expected_sites", [
     (_r1, "r1_bad_silent_pass.py", {"drain"}),
     (_r1, "r1_bad_bare_continue.py", {"poll", "<module>"}),
@@ -163,6 +168,10 @@ def _r7(name):
     (_r6, "r6_bad_from_import.py", {"solver.queries_typo"}),
     (_r7, "r7_bad_jumpdest_scan.py",
      {"valid_jump_destinations", "comp:SetComp", "for-collect"}),
+    (_r8, "r8_bad_hook_names.py", {"NOTANOP", "BOGUSOP"}),
+    (_r8, "r8_bad_missing_sinks.py",
+     {"NoSinkTable:taint-sinks", "StaleSinkTable:DELEGATECALL",
+      "StaleSinkTable:CALL:value"}),
 ])
 def test_bad_fixture_fires(runner, fixture, expected_sites):
     violations = runner(fixture)
@@ -180,6 +189,7 @@ def test_bad_fixture_fires(runner, fixture, expected_sites):
     (_r5, "r5_clean.py"),
     (_r6, "r6_clean.py"),
     (_r7, "r7_clean.py"),
+    (_r8, "r8_clean.py"),
 ])
 def test_clean_fixture_is_quiet(runner, fixture):
     assert runner(fixture) == []

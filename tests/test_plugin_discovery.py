@@ -19,6 +19,7 @@ class FakeDetector(DetectionModule, MythrilPlugin):
     description = "test detector"
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["STOP"]
+    taint_sinks = {"STOP": ()}
     plugin_default_enabled = True
 
     def _execute(self, state):

@@ -90,6 +90,29 @@ def test_serve_section_absent_for_non_serve_traces():
     assert "== serve (warmup vs requests) ==" not in report(events, other)
 
 
+def test_staticanalysis_section_absent_without_build_spans():
+    events, other = load_trace(GOLDEN)
+    assert "== static analysis" not in report(events, other)
+
+
+def test_staticanalysis_section_lists_cfa_and_taint_builds():
+    events = [
+        {"ph": "X", "name": "cfa.build", "cat": "cfa", "ts": 0,
+         "dur": 3_000, "args": {"blocks": 40, "edges": 52,
+                                "resolved": 17}},
+        {"ph": "X", "name": "taint.build", "cat": "taint", "ts": 3_000,
+         "dur": 5_000, "args": {"functions": 3, "loops": 1, "sinks": 8,
+                                "rounds": 2}},
+        {"ph": "X", "name": "taint.build", "cat": "taint", "ts": 9_000,
+         "dur": 100, "args": {"bailed": True}},
+    ]
+    text = report(events, {})
+    assert "== static analysis (per-contract builds) ==" in text
+    assert "cfa.build" in text and "blocks=40" in text
+    assert "functions=3, loops=1, rounds=2, sinks=8" in text
+    assert "bailed=True" in text
+
+
 def test_serve_section_rolls_up_warmup_and_requests():
     events = [
         {"ph": "X", "name": "serve.warmup", "cat": "serve", "ts": 0,

@@ -14,8 +14,11 @@ CONTRACT is one of:
 Prints the cfa verdict (mythril_tpu/staticanalysis/): summary counters,
 the basic-block table (pc range, terminator, successors, entry stack
 height, post-dominator merge pc), resolved/unresolved jump sites, branch
-merge points, and statically-dead code regions. ``--json`` dumps the
-raw tables instead.
+merge points, and statically-dead code regions. ``--taint`` appends the
+source->sink taint summary: recovered public functions (selectors),
+natural loops, per-sink operand taint verdicts, and the detection
+modules the module screen would skip wholesale. ``--json`` dumps the
+raw tables instead (with a ``taint`` key under ``--taint``).
 
 Host-only (the cfa pass is stdlib + in-repo frontends; no jax import).
 Exit codes: 0 on success, 2 when the input is missing/undecodable or the
@@ -148,6 +151,68 @@ def report(result, instructions) -> str:
     return "\n".join(lines)
 
 
+def _screened_module_names(disassembly) -> List[str]:
+    """Detection modules the module screen would skip wholesale for this
+    contract (hook opcodes unreachable)."""
+    from mythril_tpu.analysis.module import ModuleLoader
+    from mythril_tpu.analysis.module.base import EntryPoint
+    from mythril_tpu.analysis.module_screen import screen_modules
+
+    modules = ModuleLoader().get_detection_modules(EntryPoint.CALLBACK)
+    _, skipped = screen_modules(modules, disassembly)
+    return sorted(type(m).__name__ for m in skipped)
+
+
+def _taints_str(taints) -> str:
+    return ",".join(sorted(taints)) if taints else "-"
+
+
+def taint_report(summary, disassembly) -> str:
+    lines: List[str] = []
+    lines.append("")
+    lines.append("== taint: functions ==")
+    if summary.functions:
+        for fn in summary.functions:
+            lines.append(f"  {fn.entry_pc:#6x} {fn.selector or '(fallback)':<12} "
+                         f"{fn.name}  ({len(fn.blocks)} block(s))")
+    else:
+        lines.append("  (no dispatcher recovered — single partition)")
+
+    lines.append("")
+    lines.append("== taint: natural loops ==")
+    if summary.loops:
+        for loop in summary.loops:
+            backs = ", ".join(f"{pc:#x}" for pc in loop.back_edge_pcs)
+            lines.append(f"  header {loop.header_pc:#6x} depth {loop.depth} "
+                         f"({len(loop.blocks)} block(s), back edges: {backs})")
+    else:
+        lines.append("  (none)")
+
+    lines.append("")
+    lines.append("== taint: sink sites (operand 0 = top of stack) ==")
+    converged = "converged" if summary.converged else "NOT converged (saturated)"
+    lines.append(f"  {len(summary.sink_sites)} site(s), "
+                 f"{summary.rounds} storage round(s), {converged}")
+    for pc in sorted(summary.sink_sites):
+        site = summary.sink_sites[pc]
+        operands = "  ".join(
+            f"[{i}]={_taints_str(t)}"
+            for i, t in enumerate(site.operand_taint))
+        lines.append(f"  {pc:#6x} {site.op:<14} {operands}")
+
+    lines.append("")
+    lines.append("== taint: module screen ==")
+    skipped = _screened_module_names(disassembly)
+    if skipped:
+        lines.append(f"  {len(skipped)} module(s) skipped wholesale "
+                     "(hook opcodes unreachable):")
+        for name in skipped:
+            lines.append(f"    {name}")
+    else:
+        lines.append("  (no whole-module skips)")
+    return "\n".join(lines)
+
+
 def as_json(result) -> dict:
     """The dense tables, JSON-serializable (dict keys become strings)."""
     return {
@@ -182,6 +247,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                              f"vendored name ({'/'.join(_VENDORED)})")
     parser.add_argument("--json", action="store_true",
                         help="dump the raw cfa tables as JSON")
+    parser.add_argument("--taint", action="store_true",
+                        help="append the source->sink taint summary "
+                             "(functions, loops, sink verdicts, module "
+                             "screen)")
     args = parser.parse_args(argv)
     try:
         bytecode = load_bytecode(args.contract)
@@ -199,11 +268,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("cfaview: cfa pass bailed (empty code or over the "
               "MYTHRIL_TPU_CFA_MAX_BLOCKS budget)", file=sys.stderr)
         return 2
+    summary = None
+    if args.taint:
+        from mythril_tpu.staticanalysis import get_summary
+
+        summary = get_summary(disassembly)
+        if summary is None:
+            print("cfaview: taint summary unavailable (pass disabled "
+                  "via MYTHRIL_TPU_TAINT=0, or the fixpoint bailed)",
+                  file=sys.stderr)
+            return 2
     if args.json:
         import json
-        print(json.dumps(as_json(result), indent=2))
+        doc = as_json(result)
+        if summary is not None:
+            doc["taint"] = summary.to_json()
+            doc["taint"]["screened_modules"] = \
+                _screened_module_names(disassembly)
+        print(json.dumps(doc, indent=2))
     else:
-        print(report(result, disassembly.instruction_list))
+        text = report(result, disassembly.instruction_list)
+        if summary is not None:
+            text += "\n" + taint_report(summary, disassembly)
+        print(text)
     return 0
 
 

@@ -20,6 +20,11 @@ if [ "${1:-}" = "--lint" ]; then
 fi
 
 echo
+echo "== taint smoke (summaries + module screen on the vendored corpus) =="
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m tools.taint_smoke || exit $?
+
+echo
 echo "== serve smoke (daemon start -> request -> clean shutdown) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu \
     python -m tools.serve_smoke || exit $?

@@ -30,6 +30,10 @@ Rules (see ``tools/lint/rules/``):
   construction, ``valid_jump_destinations``) belongs to
   ``mythril_tpu/staticanalysis/``; consumers read the CFA tables through
   ``smt/solver/cfa_screen.py``.
+* **R8 hook-parity** — detection-module ``pre_hooks`` / ``post_hooks``
+  must name declared opcodes (``ops/opcodes.py``), and hooked modules
+  must declare a ``taint_sinks`` table consistent with their hook lists
+  (the taint module screen's skip contract).
 
 Run ``python -m tools.lint`` (exit 1 on violations), or via the tier-1
 suite (tests/test_lint.py). Known, audited violations live in

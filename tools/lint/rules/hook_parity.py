@@ -1,0 +1,229 @@
+"""R8 — hook parity: detection-module hooks name real opcodes and
+declare their taint sinks.
+
+Detection modules register themselves on the SVM by opcode name
+(``pre_hooks`` / ``post_hooks``); the taint module screen
+(``analysis/module_screen.py``) decides whether a module can run at all
+by intersecting those names with the contract's reachable-opcode summary.
+Both contracts fail silently when a hook name drifts from the
+``ops/opcodes.py`` table: the SVM never fires the hook (the module just
+stops detecting) and the screen treats the name as unreachable (the
+module is skipped everywhere). This rule moves both failures to lint
+time:
+
+* every name in a class's ``pre_hooks`` / ``post_hooks`` must be a
+  declared opcode in ``mythril_tpu/ops/opcodes.py`` (hook lists are
+  resolved through module-level list constants and ``+``-concatenation,
+  the two idioms the modules actually use);
+* every class that hooks opcodes must declare ``taint_sinks`` as a dict
+  literal whose keys are hooked opcodes and whose values are tuples of
+  int operand indices (``()`` = presence-only) — the screen's skip
+  decisions are only sound when the sink table and the hook lists agree.
+
+Hook lists this rule cannot resolve statically (computed at runtime)
+are skipped, not flagged — the rule under-approximates rather than
+guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import Dict, List, Optional, Set
+
+from .. import REPO_ROOT, LintContext, LintRule, Violation
+
+OPCODES_PATH = "mythril_tpu/ops/opcodes.py"
+SCAN_DIRS = ("mythril_tpu", "tools", "tests", "bench.py")
+
+HOOK_ATTRS = ("pre_hooks", "post_hooks")
+SINK_ATTR = "taint_sinks"
+
+
+def load_opcode_names() -> Set[str]:
+    """Declared opcode names, loaded straight from ops/opcodes.py by
+    file path (stdlib-only module; never drags jax in)."""
+    path = os.path.join(REPO_ROOT, OPCODES_PATH)
+    spec = importlib.util.spec_from_file_location(
+        "_tpu_lint_ops_opcodes", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return set(module.OPCODES)
+
+
+def _module_list_env(tree: ast.AST) -> Dict[str, List[str]]:
+    """Module-level ``NAME = ["A", "B"]`` string-list constants — the
+    indirection idiom hook lists use (e.g. ``CALL_LIST``)."""
+    env: Dict[str, List[str]] = {}
+    for node in getattr(tree, "body", []):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        resolved = _resolve_str_list(node.value, env)
+        if resolved is not None:
+            env[target.id] = resolved
+    return env
+
+
+def _resolve_str_list(node: ast.AST,
+                      env: Dict[str, List[str]]) -> Optional[List[str]]:
+    """A list of string constants out of a list literal, a known
+    module-level name, or a ``+`` of resolvable parts; None when any
+    piece is not statically known."""
+    if isinstance(node, (ast.List, ast.Tuple)):
+        out: List[str] = []
+        for element in node.elts:
+            if isinstance(element, ast.Constant) \
+                    and isinstance(element.value, str):
+                out.append(element.value)
+            else:
+                return None
+        return out
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _resolve_str_list(node.left, env)
+        right = _resolve_str_list(node.right, env)
+        if left is None or right is None:
+            return None
+        return left + right
+    return None
+
+
+def _class_assignments(classdef: ast.ClassDef) -> Dict[str, ast.AST]:
+    """name -> value expression for the class-body assignments this rule
+    reads (last assignment wins, matching runtime semantics)."""
+    out: Dict[str, ast.AST] = {}
+    for node in classdef.body:
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    out[target.id] = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.target, ast.Name):
+            out[node.target.id] = node.value
+    return out
+
+
+def check_file(relpath: str, tree: ast.AST,
+               opcode_names: Set[str]) -> List[Violation]:
+    env = _module_list_env(tree)
+    violations: List[Violation] = []
+    seen_tags: dict = {}
+
+    def flag(lineno: int, detail: str, tag: str) -> None:
+        ordinal = seen_tags.get(tag, 0)
+        seen_tags[tag] = ordinal + 1
+        if ordinal:
+            tag = f"{tag}#{ordinal}"
+        violations.append(Violation(
+            "R8", relpath, lineno, detail,
+            where=tag, key=f"R8:{relpath}:{tag}"))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        assigns = _class_assignments(node)
+        hooks: Set[str] = set()
+        resolvable = True
+        for attr in HOOK_ATTRS:
+            if attr not in assigns:
+                continue
+            resolved = _resolve_str_list(assigns[attr], env)
+            if resolved is None:
+                resolvable = False
+                continue
+            for name in resolved:
+                hooks.add(name)
+                if name not in opcode_names:
+                    flag(assigns[attr].lineno,
+                         f"{node.name}.{attr} hooks {name!r}, which is "
+                         "not a declared opcode in "
+                         f"{OPCODES_PATH} — the SVM will never fire "
+                         "this hook and the taint module screen will "
+                         "treat it as unreachable", name)
+        if not hooks:
+            # hookless class, empty hook lists (the base), or a hook
+            # list the rule cannot resolve — under-approximate
+            continue
+
+        if SINK_ATTR not in assigns:
+            flag(node.lineno,
+                 f"{node.name} hooks opcodes but declares no "
+                 f"`{SINK_ATTR}` — the taint module screen "
+                 "(analysis/module_screen.py) needs the sink table to "
+                 "decide skips soundly; declare `{\"OP\": ()}` entries "
+                 "(empty tuple = presence-only)",
+                 f"{node.name}:taint-sinks")
+            continue
+        sinks = assigns[SINK_ATTR]
+        if not isinstance(sinks, ast.Dict):
+            flag(sinks.lineno,
+                 f"{node.name}.{SINK_ATTR} must be a dict literal "
+                 "(opcode -> tuple of operand indices) so the screen's "
+                 "contract is statically auditable",
+                 f"{node.name}:taint-sinks")
+            continue
+        for key_node, value_node in zip(sinks.keys, sinks.values):
+            if not (isinstance(key_node, ast.Constant)
+                    and isinstance(key_node.value, str)):
+                flag(sinks.lineno,
+                     f"{node.name}.{SINK_ATTR} has a non-string-literal "
+                     "key — sink opcodes must be spelled out",
+                     f"{node.name}:taint-sinks")
+                continue
+            key = key_node.value
+            if key not in opcode_names:
+                flag(key_node.lineno,
+                     f"{node.name}.{SINK_ATTR} names {key!r}, which is "
+                     f"not a declared opcode in {OPCODES_PATH}",
+                     f"{node.name}:{key}")
+            elif resolvable and key not in hooks:
+                flag(key_node.lineno,
+                     f"{node.name}.{SINK_ATTR} names {key!r}, which is "
+                     "not among the class's pre/post hooks — the screen "
+                     "only consults sinks at hooked sites, so this "
+                     "entry is dead (typo or stale hook list)",
+                     f"{node.name}:{key}")
+            ok_value = isinstance(value_node, ast.Tuple) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in value_node.elts)
+            if not ok_value:
+                flag(value_node.lineno,
+                     f"{node.name}.{SINK_ATTR}[{key!r}] must be a tuple "
+                     "of int operand indices (() = presence-only)",
+                     f"{node.name}:{key}:value")
+    return violations
+
+
+class HookParityRule(LintRule):
+    code = "R8"
+    name = "hook-parity"
+    description = ("detection-module pre/post hooks must name declared "
+                   "opcodes (ops/opcodes.py) and hooked modules must "
+                   "declare a consistent taint_sinks table")
+
+    def run(self, ctx: LintContext) -> List[Violation]:
+        opcode_names = load_opcode_names()
+        violations: List[Violation] = []
+        for path in ctx.iter_py(*SCAN_DIRS):
+            relpath = ctx.relpath(path)
+            if relpath.startswith("tools/lint/") \
+                    or relpath == "tools/check_excepts.py" \
+                    or relpath.startswith("tests/data/lint/"):
+                continue
+            violations.extend(
+                check_file(relpath, ctx.tree(path), opcode_names))
+        return violations
+
+    def check_paths(self, ctx: LintContext, paths) -> List[Violation]:
+        opcode_names = load_opcode_names()
+        violations: List[Violation] = []
+        for path in paths:
+            violations.extend(
+                check_file(ctx.relpath(path), ctx.tree(path),
+                           opcode_names))
+        return violations

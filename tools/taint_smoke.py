@@ -1,0 +1,86 @@
+"""Taint-analysis smoke for the pre-merge gate (tools/check.sh).
+
+Stdlib + in-repo frontends only (no jax import, no symbolic execution),
+so it runs in a couple of seconds:
+
+1. build the per-contract taint summary for both vendored headline
+   contracts (killbilly, bectoken);
+2. require non-empty sink tables, a converged fixpoint, and the
+   dispatcher functions recovered;
+3. run the module screen over the full CALLBACK module set and require
+   at least one whole-module skip on at least one contract — the
+   acceptance bar behind ``taint.screen.modules_skipped``.
+
+Prints ``TAINT_SMOKE=ok`` on success; any failure exits non-zero with a
+diagnostic.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from mythril_tpu.analysis import module_screen
+    from mythril_tpu.analysis.module import ModuleLoader
+    from mythril_tpu.analysis.module.base import EntryPoint
+    from mythril_tpu.frontends.asm import assemble, dispatcher
+    from mythril_tpu.frontends.disassembler import Disassembly
+    from mythril_tpu.observe import metrics
+    from mythril_tpu.staticanalysis import get_summary
+    from tools.measure_headline import BECTOKEN, KILLBILLY
+
+    modules = ModuleLoader().get_detection_modules(EntryPoint.CALLBACK)
+    if not modules:
+        print("taint_smoke: no CALLBACK modules loaded", file=sys.stderr)
+        return 1
+
+    any_skipped = False
+    for name, spec in (("killbilly", KILLBILLY), ("bectoken", BECTOKEN)):
+        disassembly = Disassembly(assemble(dispatcher(spec)).hex())
+        summary = get_summary(disassembly)
+        if summary is None:
+            print(f"taint_smoke: no summary for {name}", file=sys.stderr)
+            return 1
+        if not summary.sink_sites:
+            print(f"taint_smoke: empty sink table for {name}",
+                  file=sys.stderr)
+            return 1
+        if not summary.converged:
+            print(f"taint_smoke: fixpoint did not converge on {name}",
+                  file=sys.stderr)
+            return 1
+        if len(summary.functions) < 2:
+            print(f"taint_smoke: dispatcher not recovered for {name} "
+                  f"({len(summary.functions)} function(s))",
+                  file=sys.stderr)
+            return 1
+        kept, skipped = module_screen.screen_modules(modules, disassembly)
+        if len(kept) + len(skipped) != len(modules):
+            print(f"taint_smoke: screen lost modules on {name}",
+                  file=sys.stderr)
+            return 1
+        print(f"taint_smoke: {name}: {len(summary.functions)} function(s), "
+              f"{len(summary.sink_sites)} sink(s), "
+              f"{len(skipped)} module(s) skipped"
+              + (f" ({', '.join(sorted(type(m).__name__ for m in skipped))})"
+                 if skipped else ""))
+        any_skipped = any_skipped or bool(skipped)
+
+    if not any_skipped:
+        print("taint_smoke: no whole-module skip on any vendored "
+              "contract", file=sys.stderr)
+        return 1
+    if metrics.snapshot().get("taint.screen.modules_skipped", 0) < 1:
+        print("taint_smoke: taint.screen.modules_skipped not counted",
+              file=sys.stderr)
+        return 1
+    print("TAINT_SMOKE=ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

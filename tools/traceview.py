@@ -207,6 +207,7 @@ def report(events: List[dict], other: Dict[str, object]) -> str:
     else:
         lines.append("  (no xla.compile spans — every bucket was warm)")
 
+    lines.extend(_staticanalysis_section(spans))
     lines.extend(_serve_section(spans))
 
     if instants:
@@ -219,6 +220,23 @@ def report(events: List[dict], other: Dict[str, object]) -> str:
                          f"{event['name']}" + (f"  ({detail})" if detail
                                                else ""))
     return "\n".join(lines)
+
+
+def _staticanalysis_section(spans: List[dict]) -> List[str]:
+    """Per-contract static-analysis builds: one line per ``cfa.build`` /
+    ``taint.build`` span with the table sizes it produced (or ``bailed``
+    when the pass gave up). Empty (section omitted) for traces without
+    those spans, so existing reports are unchanged."""
+    builds = [s for s in spans if s["name"] in ("cfa.build", "taint.build")]
+    if not builds:
+        return []
+    lines = ["", "== static analysis (per-contract builds) =="]
+    for span in sorted(builds, key=lambda s: float(s.get("ts", 0.0))):
+        args = span.get("args", {})
+        detail = ", ".join(f"{k}={v}" for k, v in sorted(args.items()))
+        lines.append(f"  {span['name']:<12} {_fmt_us(float(span.get('dur', 0.0))):>9}"
+                     + (f"  ({detail})" if detail else ""))
+    return lines
 
 
 def _serve_section(spans: List[dict]) -> List[str]:
