@@ -116,11 +116,25 @@ def _run_engine(engine: str, seconds: float):
     }
 
 
+def _frontier_rollup():
+    """Frontier-utilization slice of the metrics registry (fed by the
+    device-resident telemetry plane) for the BENCH json — device step
+    counts by themselves say nothing about how full the lanes ran."""
+    from mythril_tpu.observe import metrics
+
+    rollup = {name: int(metrics.value(f"frontier.telemetry.{name}"))
+              for name in ("executed", "forks", "escapes", "reseeds",
+                           "deaths", "cold_sload_pauses")}
+    rollup["mean_lane_occupancy"] = round(
+        float(metrics.value("frontier.telemetry.occupancy")), 1)
+    return rollup
+
+
 def main():
     seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 45.0
     import jax
 
-    from mythril_tpu.observe import trace
+    from mythril_tpu.observe import metrics, trace
 
     # every bench run leaves a Perfetto trace beside its BENCH_*.json
     # (inspect with `python -m tools.traceview bench_trace.json`); an
@@ -128,6 +142,10 @@ def main():
     trace_path = os.environ.get("MYTHRIL_TPU_TRACE") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_trace.json")
     trace.enable(trace_path)
+    # fsync-atomic metrics snapshot beside the trace (frontier telemetry,
+    # dispatch counters); an explicit MYTHRIL_TPU_METRICS wins
+    metrics_path = os.environ.get("MYTHRIL_TPU_METRICS") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_metrics.json")
 
     backend = jax.devices()[0].platform
     trace.set_manifest(tool="bench.py", backend=backend,
@@ -169,6 +187,7 @@ def main():
 
     if tpu_info["forks_on_device"] > 0 and tpu_rate > host_rate:
         trace.export()
+        metrics.write_snapshot(metrics_path)
         print(json.dumps({
             "metric": "sym_states_per_sec",
             "value": round(tpu_rate, 1),
@@ -180,8 +199,10 @@ def main():
             "n_lanes": int(os.environ["MYTHRIL_TPU_LANES"]),
             "tpu": tpu_info,
             "host": host_info,
+            "frontier": _frontier_rollup(),
             "corpus": _corpus_extras(),
             "trace": trace_path,
+            "metrics": metrics_path,
         }), flush=True)
         return
     # the symbolic frontier did not win wall-clock in this environment
@@ -195,6 +216,7 @@ def main():
         oracle_rate = _oracle_concrete_rate(seconds=min(seconds, 10.0))
     _phase("oracle", steps_per_sec=round(oracle_rate, 1))
     trace.export()
+    metrics.write_snapshot(metrics_path)
     print(json.dumps({
         "metric": "lockstep_lane_steps_per_sec",
         "value": round(lockstep_rate, 1),
@@ -206,8 +228,10 @@ def main():
         "sym_host_states_per_sec": round(host_rate, 1),
         "sym_tpu": tpu_info,
         "sym_host": host_info,
+        "frontier": _frontier_rollup(),
         "corpus": _corpus_extras(),
         "trace": trace_path,
+        "metrics": metrics_path,
     }), flush=True)
 
 

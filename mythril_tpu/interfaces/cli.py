@@ -81,6 +81,13 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
                               "(staticanalysis/taint.py): detection "
                               "modules register and fire on every hook "
                               "site again (A/B measurement)")
+    options.add_argument("--no-frontier-telemetry", action="store_true",
+                         help="compile the device-resident frontier "
+                              "counter plane out of the fused step "
+                              "(parallel/symstep.py): no opcode-class "
+                              "histogram, lifecycle counters, or counter "
+                              "tracks in the trace (A/B measurement; same "
+                              "as MYTHRIL_TPU_FRONTIER_TELEMETRY=0)")
     options.add_argument("--engine", default="host", choices=["host", "tpu"],
                          help="exploration engine: host worklist or the "
                               "batched TPU symbolic frontier")
@@ -109,6 +116,13 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
                               "compiles) to PATH; same as MYTHRIL_TPU_TRACE; "
                               "inspect with `python -m tools.traceview PATH` "
                               "or load at https://ui.perfetto.dev")
+    options.add_argument("--metrics-out", default=None, metavar="PATH",
+                         help="write an fsync-atomic JSON snapshot of the "
+                              "observe/metrics registry (counters, gauges, "
+                              "frontier telemetry) to PATH when the "
+                              "analysis finishes; same as "
+                              "MYTHRIL_TPU_METRICS; inspect with "
+                              "`python -m tools.frontierview --metrics PATH`")
     options.add_argument("--device-crosscheck", type=int, default=0,
                          metavar="N",
                          help="re-decide every Nth device sat/unsat verdict "

@@ -63,6 +63,8 @@ class MythrilAnalyzer:
         args.batch_solve = not getattr(cmd, "no_batch_solve", False)
         args.cfa = not getattr(cmd, "no_cfa", False)
         args.taint = not getattr(cmd, "no_taint", False)
+        args.frontier_telemetry = not getattr(
+            cmd, "no_frontier_telemetry", False)
         args.device_crosscheck = getattr(cmd, "device_crosscheck", 0)
         args.inject_fault = getattr(cmd, "inject_fault", None)
         solver = getattr(cmd, "solver", None)
@@ -76,6 +78,10 @@ class MythrilAnalyzer:
         # span tracer: --trace-out wins over MYTHRIL_TPU_TRACE (observe/)
         from ..support import tpu_config
 
+        # metrics snapshot: --metrics-out wins over MYTHRIL_TPU_METRICS;
+        # written (fsync-atomic) at the end of fire_lasers
+        self.metrics_out = getattr(cmd, "metrics_out", None) \
+            or tpu_config.get_str("MYTHRIL_TPU_METRICS")
         trace_out = getattr(cmd, "trace_out", None) \
             or tpu_config.get_str("MYTHRIL_TPU_TRACE")
         if trace_out:
@@ -194,6 +200,10 @@ class MythrilAnalyzer:
         # an exporting analyzer embedded in a longer process still leaves a
         # loadable file behind)
         trace.export()
+        if self.metrics_out:
+            from ..observe import metrics
+
+            metrics.write_snapshot(self.metrics_out)
         return report
 
 

@@ -120,6 +120,41 @@ _METRICS: List[MetricSpec] = [
                "Lanes paused on a cold SLOAD serviced by the host."),
     MetricSpec("frontier.drain.rows", HISTOGRAM, "rows",
                "Escape rows fetched per bulk host drain."),
+    # -- device-resident frontier telemetry plane (parallel/symstep.py) ----------
+    MetricSpec("frontier.telemetry.executed", COUNTER, "1",
+               "Instruction-states stepped on device, decoded from the "
+               "in-kernel opcode-class histogram."),
+    MetricSpec("frontier.telemetry.forks", COUNTER, "1",
+               "On-device JUMPI forks (lane claims + DFS-stack pushes + "
+               "escape-buffer spills)."),
+    MetricSpec("frontier.telemetry.escapes", COUNTER, "1",
+               "Lanes that escaped to the host (buffered + frozen)."),
+    MetricSpec("frontier.telemetry.reseeds", COUNTER, "1",
+               "DEAD lanes reseeded from the device sibling stack."),
+    MetricSpec("frontier.telemetry.deaths", COUNTER, "1",
+               "Lanes killed on device (error exits + arena-overflow "
+               "guards + invalid jump destinations)."),
+    MetricSpec("frontier.telemetry.cold_sload_pauses", COUNTER, "1",
+               "Lane pauses at a cold SLOAD counted in-kernel (the host "
+               "service itself counts frontier.cold_sloads)."),
+    MetricSpec("frontier.telemetry.occupancy", GAUGE, "lanes",
+               "Mean running lanes per fused step, this device phase."),
+    MetricSpec("frontier.telemetry.stack_hwm", GAUGE, "rows",
+               "DFS sibling-stack depth high-water, this device phase."),
+    MetricSpec("frontier.telemetry.esc_hwm", GAUGE, "rows",
+               "Escape-buffer occupancy high-water, this device phase."),
+    MetricSpec("frontier.telemetry.op_class", HISTOGRAM, "1",
+               "Per-chunk executed instructions by opcode class "
+               "(label = class, symstep.OP_CLASS_NAMES)."),
+    MetricSpec("frontier.telemetry.esc_cause", HISTOGRAM, "1",
+               "Per-chunk lane escapes by cause "
+               "(label = cause, symstep.ESC_CAUSE_NAMES)."),
+    MetricSpec("frontier.telemetry.lifecycle", HISTOGRAM, "1",
+               "Per-chunk lane lifecycle transitions "
+               "(label = transition, symstep.LIFECYCLE_NAMES)."),
+    MetricSpec("frontier.telemetry.tag_occupancy", HISTOGRAM, "1",
+               "Per-chunk running-lane-steps at tagged merge-point / "
+               "loop-header pcs (label = merge@pc / loop@pc)."),
     # -- checkpoints (support/checkpoint.py, parallel/frontier.py) ---------------
     MetricSpec("checkpoint.saves", COUNTER, "1",
                "Crash-safe checkpoint writes (host pickle + device npz)."),
@@ -338,6 +373,33 @@ def reset(prefix: str = "") -> None:
         for name in list(_STORE.hists):
             if name.startswith(prefix):
                 del _STORE.hists[name]
+
+
+def write_snapshot(path: str) -> str:
+    """Write :func:`snapshot` as JSON, fsync-atomically (tmp + fsync +
+    rename, the support/checkpoint.py discipline — a crash mid-write must
+    never leave a truncated snapshot where bench/frontierview will read
+    it). Stdlib-only like the rest of this module; returns `path`."""
+    import json
+    import os
+
+    payload = json.dumps(snapshot(), indent=2, sort_keys=True, default=str)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as handle:
+        handle.write(payload + "\n")
+        handle.flush()
+        os.fsync(handle.fileno())
+    os.replace(tmp, path)
+    directory = os.path.dirname(os.path.abspath(path))
+    try:
+        dir_fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return path  # platform without directory fds: rename is done
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+    return path
 
 
 def render_markdown_table() -> str:

@@ -28,9 +28,11 @@ Design constraints, in order:
   its head would misreport every rollup.
 * **Perfetto-loadable output.** ``export()`` writes the Chrome
   ``trace_event`` JSON object format: ``X`` complete events (ts/dur in
-  microseconds), ``i`` instants, ``M`` process/thread metadata, and the
-  run manifest under ``otherData``. Load it at https://ui.perfetto.dev
-  or feed it to ``python -m tools.traceview``.
+  microseconds), ``i`` instants, ``C`` counter-track samples (args =
+  series values; the frontier telemetry decode emits these per chunk),
+  ``M`` process/thread metadata, and the run manifest under
+  ``otherData``. Load it at https://ui.perfetto.dev or feed it to
+  ``python -m tools.traceview`` / ``python -m tools.frontierview``.
 
 Enablement: ``MYTHRIL_TPU_TRACE=out.json`` (checked once, at first
 use — the tracer is a process-level run setting, unlike the call-time
@@ -181,6 +183,13 @@ class Tracer:
     def instant(self, name: str, attrs: Optional[dict]) -> None:
         self._record("i", name, time.perf_counter(), None, attrs)
 
+    def counter(self, name: str, values: dict) -> None:
+        """Perfetto counter ('C') sample: each key of `values` is one
+        series on the track named `name` — Perfetto renders them as
+        stacked area curves over the run timeline (frontier occupancy,
+        escape rates, arena fill)."""
+        self._record("C", name, time.perf_counter(), None, values)
+
     def set_manifest(self, **entries) -> None:
         with self._lock:
             self._manifest.update(entries)
@@ -286,6 +295,16 @@ def instant(name: str, **attrs) -> None:
         tracer._maybe_init_from_env()
     if tracer.enabled:
         tracer.instant(name, attrs or None)
+
+
+def counter(name: str, **values) -> None:
+    """Sample the named counter track: every kwarg is one series value
+    (Chrome trace_event 'C' phase). No-op when tracing is off."""
+    tracer = _TRACER
+    if not tracer._checked_env:
+        tracer._maybe_init_from_env()
+    if tracer.enabled:
+        tracer.counter(name, values)
 
 
 def enabled() -> bool:

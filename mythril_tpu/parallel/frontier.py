@@ -99,7 +99,10 @@ def _summary(state, planes, arena, sched):
     costs ~400 ms while this single [13 + 2B] download costs one floor.
     Layout: [stack_top, esc_count, executed, forks, pushes, pops, arena_n,
     arena_n_const, esc_msize_max, esc_sp_max, esc_slots_max, esc_conds_max,
-    batch] then status[B] then fork_cond[B]."""
+    batch] then status[B] then fork_cond[B], then — only when the
+    telemetry plane is armed — symstep.telemetry_words(sched.telemetry)
+    appended at the END (existing offsets stay valid; the counters ride
+    the same single download, zero extra host syncs)."""
     esc_rows = sched.esc_state.status.shape[0]
     live = jnp.arange(esc_rows) < sched.esc_count
 
@@ -118,8 +121,12 @@ def _summary(state, planes, arena, sched):
         live_max(sched.esc_planes.cond_count).astype(jnp.int64),
         jnp.asarray(batch, dtype=jnp.int64),
     ])
-    return jnp.concatenate([scalars, state.status.astype(jnp.int64),
-                            planes.fork_cond.astype(jnp.int64)])
+    packed = jnp.concatenate([scalars, state.status.astype(jnp.int64),
+                              planes.fork_cond.astype(jnp.int64)])
+    if sched.telemetry is not None:
+        packed = jnp.concatenate(
+            [packed, symstep.telemetry_words(sched.telemetry)])
+    return packed
 
 
 #: _drain_light int32-section field layout: (name, per-row element count fn)
@@ -416,6 +423,22 @@ class _Frontier:
         #: scheduler pool byte budgets (HBM)
         self.stack_bytes = tpu_config.get_int("MYTHRIL_TPU_STACK_BYTES")
         self.esc_bytes = tpu_config.get_int("MYTHRIL_TPU_ESC_BYTES")
+        #: device-resident counter plane (symstep.Telemetry): knob AND the
+        #: CLI A/B flag must both be on. Off means the counters are
+        #: compiled OUT of the fused step entirely (None is a static
+        #: pytree leaf), so --no-frontier-telemetry measures a genuinely
+        #: telemetry-free executable
+        from ..support.support_args import args as _support_args
+
+        self.telemetry_enabled = (
+            tpu_config.get_flag("MYTHRIL_TPU_FRONTIER_TELEMETRY")
+            and getattr(_support_args, "frontier_telemetry", True))
+        #: host-side names for the telemetry tag slots ("merge@0x..",
+        #: "loop@0x..") — parallel to Telemetry.tag_pcs
+        self.tag_names: List[str] = []
+        #: previous chunk's raw telemetry words (device counters are
+        #: cumulative within a phase; deltas are published per chunk)
+        self._tel_prev: Optional[np.ndarray] = None
 
     def _harena(self, used=None, used_const=None) -> A.HostArena:
         """The persistent incremental host mirror of the arena (term memo
@@ -447,7 +470,51 @@ class _Frontier:
         log.info("device scheduler: %d stack + %d escape rows x %d B "
                  "(%.0f MiB HBM)", stack_rows, esc_rows, row_bytes,
                  (stack_rows + esc_rows) * row_bytes / 2 ** 20)
-        return symstep.new_scheduler(state, planes, stack_rows, esc_rows)
+        telemetry = None
+        if self.telemetry_enabled:
+            tag_pcs, self.tag_names = self._collect_tag_pcs()
+            telemetry = symstep.new_telemetry(tag_pcs)
+            self._tel_prev = None  # device counters restart each phase
+        return symstep.new_scheduler(state, planes, stack_rows, esc_rows,
+                                     telemetry=telemetry)
+
+    #: telemetry tag-occupancy slots — one B x K compare per fused step,
+    #: so the table stays small; overflow is logged, never silent
+    TAG_SLOTS = 32
+
+    def _collect_tag_pcs(self) -> Tuple[List[int], List[str]]:
+        """Merge-point and loop-header pcs to track lane occupancy at,
+        from the CFA / taint tables seed() already warmed. Loop headers
+        first (fewer, and they drive the unroll budgeter), then
+        post-dominator merge points until the slot cap."""
+        loops: List[Tuple[int, str]] = []
+        merges: List[Tuple[int, str]] = []
+        seen = set()
+        for ctx in self.contexts:
+            code = ctx.template.environment.code
+            summary = module_screen.summary_for(code)
+            if summary is not None:
+                for loop in summary.loops:
+                    key = ("loop", loop.header_pc)
+                    if key not in seen:
+                        seen.add(key)
+                        loops.append((loop.header_pc,
+                                      f"loop@{loop.header_pc:#x}"))
+            cfa = cfa_screen.cfa_for(code)
+            if cfa is not None:
+                for pc in sorted(cfa.merge_points):
+                    key = ("merge", pc)
+                    if key not in seen:
+                        seen.add(key)
+                        merges.append((pc, f"merge@{pc:#x}"))
+        tags = (loops + merges)[:self.TAG_SLOTS]
+        dropped = len(loops) + len(merges) - len(tags)
+        if dropped:
+            log.info("frontier telemetry: tracking %d of %d tagged pcs "
+                     "(%d merge points dropped past the %d-slot cap)",
+                     len(tags), len(tags) + dropped, dropped,
+                     self.TAG_SLOTS)
+        return [pc for pc, _ in tags], [name for _, name in tags]
 
     # -- seeding -----------------------------------------------------------------------
 
@@ -681,6 +748,12 @@ class _Frontier:
             status = packed[13:13 + self.n_lanes].astype(np.int32)
             fork_cond = packed[13 + self.n_lanes:
                                13 + 2 * self.n_lanes].astype(np.int32)
+            if sched.telemetry is not None:
+                self._publish_telemetry(
+                    packed[13 + 2 * self.n_lanes:],
+                    running=int(np.sum(status == RUNNING)),
+                    stack_top=stack_top, esc_count=esc_count,
+                    arena_n=arena_n)
             self.lane_steps = lane_base + executed
             self.forks = fork_base + forks
             self.stack_pushes = push_base + pushes
@@ -774,6 +847,77 @@ class _Frontier:
             self._flush_backlog(backlog)
         self._hand_over_running(state, planes, sched)
         self._discard_checkpoint(checkpoint_path)
+
+    def _publish_telemetry(self, tel_words, running: int, stack_top: int,
+                           esc_count: int, arena_n: int) -> None:
+        """Decode one chunk's telemetry words (cumulative device counters,
+        already fetched in the summary — pure host numpy, zero extra
+        syncs) into per-chunk deltas published as declared metrics and
+        Perfetto counter ('C') tracks."""
+        tel_words = np.asarray(tel_words, dtype=np.int64)
+        prev = self._tel_prev
+        if prev is None or prev.shape != tel_words.shape:
+            prev = np.zeros_like(tel_words)
+        delta = tel_words - prev
+        self._tel_prev = tel_words
+        n_op, n_lc = symstep.N_OP_CLASSES, symstep.N_LIFECYCLE
+        n_ec = symstep.N_ESC_CAUSES
+        op_d = delta[:n_op]
+        lc = dict(zip(symstep.LIFECYCLE_NAMES,
+                      (int(v) for v in delta[n_op:n_op + n_lc])))
+        ec_d = delta[n_op + n_lc:n_op + n_lc + n_ec]
+        occupancy = tel_words[n_op + n_lc + n_ec:n_op + n_lc + n_ec + 2]
+        hwm = tel_words[n_op + n_lc + n_ec + 2:n_op + n_lc + n_ec + 4]
+        tag_d = delta[n_op + n_lc + n_ec + 4:]
+
+        metrics.inc("frontier.telemetry.executed", int(np.sum(op_d)))
+        metrics.inc("frontier.telemetry.forks",
+                    lc["forks_claimed"] + lc["forks_pushed"]
+                    + lc["forks_spilled"])
+        metrics.inc("frontier.telemetry.escapes",
+                    lc["esc_buffered"] + lc["esc_frozen"])
+        metrics.inc("frontier.telemetry.reseeds", lc["reseeds"])
+        metrics.inc("frontier.telemetry.deaths",
+                    lc["err_deaths"] + lc["overflow_kills"]
+                    + lc["bad_jump_deaths"])
+        metrics.inc("frontier.telemetry.cold_sload_pauses",
+                    lc["cold_sloads"])
+        metrics.set_gauge("frontier.telemetry.stack_hwm", int(hwm[0]))
+        metrics.set_gauge("frontier.telemetry.esc_hwm", int(hwm[1]))
+        if int(occupancy[1]):
+            metrics.set_gauge("frontier.telemetry.occupancy",
+                              float(occupancy[0]) / float(occupancy[1]))
+        for name, count in zip(symstep.OP_CLASS_NAMES, op_d):
+            if count:
+                metrics.observe("frontier.telemetry.op_class", int(count),
+                                label=name)
+        for name, count in zip(symstep.ESC_CAUSE_NAMES, ec_d):
+            if count:
+                metrics.observe("frontier.telemetry.esc_cause", int(count),
+                                label=name)
+        for name, count in lc.items():
+            if count:
+                metrics.observe("frontier.telemetry.lifecycle", count,
+                                label=name)
+        for name, count in zip(self.tag_names, tag_d):
+            if count:
+                metrics.observe("frontier.telemetry.tag_occupancy",
+                                int(count), label=name)
+        if trace.enabled():
+            trace.counter("frontier.lanes", running=running,
+                          stack=stack_top, escaped=esc_count)
+            trace.counter("frontier.arena", nodes=arena_n)
+            trace.counter("frontier.ops", **{
+                name: int(count)
+                for name, count in zip(symstep.OP_CLASS_NAMES, op_d)})
+            trace.counter("frontier.causes", **{
+                name: int(count)
+                for name, count in zip(symstep.ESC_CAUSE_NAMES, ec_d)})
+            trace.counter("frontier.lifecycle", **lc)
+            if self.tag_names:
+                trace.counter("frontier.tags", **{
+                    name: int(count)
+                    for name, count in zip(self.tag_names, tag_d)})
 
     @staticmethod
     def _discard_checkpoint(checkpoint_path) -> None:

@@ -82,6 +82,95 @@ for _name, _cls in [("CALLER", A.V_CALLER), ("ORIGIN", A.V_ORIGIN),
 ENV_CLASS_T = jnp.asarray(_ENV_CLASS)
 
 
+# ---- telemetry plane ------------------------------------------------------------
+# A small block of device-resident counters accumulated inside the fused
+# step and piggybacked onto the per-chunk summary download (zero extra
+# host syncs). `DeviceScheduler.telemetry is None` is a *static* Python
+# branch under jit: the telemetry-off program contains no telemetry ops
+# at all, so the A/B flag compares genuinely different executables.
+
+#: opcode byte -> execution-histogram class
+OP_CLASS_NAMES = ("arith", "cmp", "keccak", "env", "block", "mem",
+                  "storage", "jump", "push", "dup", "swap", "log", "call",
+                  "halt", "other")
+N_OP_CLASSES = len(OP_CLASS_NAMES)
+OP_CLASS = np.full(256, OP_CLASS_NAMES.index("other"), dtype=np.int32)
+OP_CLASS[0x01:0x0C] = OP_CLASS_NAMES.index("arith")
+OP_CLASS[0x10:0x1E] = OP_CLASS_NAMES.index("cmp")
+OP_CLASS[0x20] = OP_CLASS_NAMES.index("keccak")
+OP_CLASS[0x30:0x40] = OP_CLASS_NAMES.index("env")
+OP_CLASS[0x5A] = OP_CLASS_NAMES.index("env")        # GAS
+OP_CLASS[0x40:0x4B] = OP_CLASS_NAMES.index("block")
+for _byte in (0x50, 0x51, 0x52, 0x53, 0x59, 0x5E):  # POP, M*, MSIZE, MCOPY
+    OP_CLASS[_byte] = OP_CLASS_NAMES.index("mem")
+for _byte in (0x54, 0x55, 0x5C, 0x5D):              # SLOAD/SSTORE/TLOAD/TSTORE
+    OP_CLASS[_byte] = OP_CLASS_NAMES.index("storage")
+for _byte in (0x56, 0x57, 0x58, 0x5B):              # JUMP/JUMPI/PC/JUMPDEST
+    OP_CLASS[_byte] = OP_CLASS_NAMES.index("jump")
+OP_CLASS[0x5F:0x80] = OP_CLASS_NAMES.index("push")
+OP_CLASS[0x80:0x90] = OP_CLASS_NAMES.index("dup")
+OP_CLASS[0x90:0xA0] = OP_CLASS_NAMES.index("swap")
+OP_CLASS[0xA0:0xA5] = OP_CLASS_NAMES.index("log")
+OP_CLASS[0xF0:0xFB] = OP_CLASS_NAMES.index("call")
+for _byte in (0x00, 0xF3, 0xFD, 0xFE, 0xFF):  # STOP/RETURN/REVERT/INVALID/SD
+    OP_CLASS[_byte] = OP_CLASS_NAMES.index("halt")
+OP_CLASS_T = jnp.asarray(OP_CLASS)
+
+#: lane lifecycle transition counters (LIVE→DEAD/FORKING/ESCAPED + pauses)
+LIFECYCLE_NAMES = ("reseeds", "err_deaths", "overflow_kills",
+                   "bad_jump_deaths", "esc_buffered", "esc_frozen",
+                   "fork_waits", "cold_sloads", "forks_claimed",
+                   "forks_pushed", "forks_spilled", "frozen_revived")
+N_LIFECYCLE = len(LIFECYCLE_NAMES)
+
+#: why lanes escaped to the host, priority-ordered most-specific-last
+ESC_CAUSE_NAMES = ("halt", "sym_jump_dest", "detector_branch",
+                   "sym_mem_off", "dirty_mload", "sym_storage_key",
+                   "sym_mem_region", "host_op")
+N_ESC_CAUSES = len(ESC_CAUSE_NAMES)
+
+
+class Telemetry(NamedTuple):
+    """Device-resident frontier counters (cumulative across chunks)."""
+
+    op_hist: jnp.ndarray    # i64[N_OP_CLASSES] executed per opcode class
+    lifecycle: jnp.ndarray  # i64[N_LIFECYCLE]
+    esc_cause: jnp.ndarray  # i64[N_ESC_CAUSES]
+    occupancy: jnp.ndarray  # i64[2] — (running-lane-step sum, steps)
+    hwm: jnp.ndarray        # i64[2] — (stack_top high-water, esc_count hw)
+    tag_pcs: jnp.ndarray    # i32[K] static merge/loop-header pcs (-1 empty)
+    tag_occ: jnp.ndarray    # i64[K] running-lane-steps at each tagged pc
+
+
+#: summary words contributed before the variable-length tag_occ block
+TELEMETRY_FIXED_WORDS = N_OP_CLASSES + N_LIFECYCLE + N_ESC_CAUSES + 2 + 2
+
+
+def new_telemetry(tag_pcs=None) -> Telemetry:
+    """Zeroed counter plane. `tag_pcs` is a host-side int sequence of
+    merge-point / loop-header byte addresses to track occupancy at."""
+    pcs = np.asarray([] if tag_pcs is None else list(tag_pcs),
+                     dtype=np.int32)
+    i64 = jnp.int64
+    return Telemetry(
+        op_hist=jnp.zeros(N_OP_CLASSES, dtype=i64),
+        lifecycle=jnp.zeros(N_LIFECYCLE, dtype=i64),
+        esc_cause=jnp.zeros(N_ESC_CAUSES, dtype=i64),
+        occupancy=jnp.zeros(2, dtype=i64),
+        hwm=jnp.zeros(2, dtype=i64),
+        tag_pcs=jnp.asarray(pcs),
+        tag_occ=jnp.zeros(pcs.shape[0], dtype=i64),
+    )
+
+
+def telemetry_words(tel: Telemetry) -> jnp.ndarray:
+    """Flatten the counters into the i64 vector appended to the per-chunk
+    summary (layout: op_hist | lifecycle | esc_cause | occupancy | hwm |
+    tag_occ; tag_pcs is static and never downloaded)."""
+    return jnp.concatenate([tel.op_hist, tel.lifecycle, tel.esc_cause,
+                            tel.occupancy, tel.hwm, tel.tag_occ])
+
+
 class SymPlanes(NamedTuple):
     """Symbolic shadow of the concrete StateBatch (0 = concrete everywhere)."""
 
@@ -150,10 +239,12 @@ class DeviceScheduler(NamedTuple):
     pushes: jnp.ndarray        # i64 — siblings pushed to the stack
     pops: jnp.ndarray          # i64 — siblings reseeded from the stack
     enabled: jnp.ndarray       # bool — False = legacy freeze/escape semantics
+    telemetry: Optional[Telemetry] = None  # None = telemetry compiled out
 
 
 def new_scheduler(state: StateBatch, planes: SymPlanes, stack_rows: int,
-                  esc_rows: int, disabled: bool = False) -> DeviceScheduler:
+                  esc_rows: int, disabled: bool = False,
+                  telemetry: Optional[Telemetry] = None) -> DeviceScheduler:
     """Allocate scheduler pools shaped like (state, planes) rows. With
     `disabled`, pushes/buffering/reseeds never engage — the legacy
     freeze-and-escape semantics for callers without a driver."""
@@ -172,6 +263,7 @@ def new_scheduler(state: StateBatch, planes: SymPlanes, stack_rows: int,
         pushes=jnp.asarray(0, dtype=jnp.int64),
         pops=jnp.asarray(0, dtype=jnp.int64),
         enabled=jnp.asarray(not disabled),
+        telemetry=telemetry,
     )
 
 
@@ -207,6 +299,8 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
     # transaction-end opcodes explicitly; ERRORED here covers stack
     # under/overflow and out-of-gas bookkeeping, matching the round-4
     # service's reap) — free them so forks/reseeds can claim the slot
+    if sched.telemetry is not None:
+        n_err_freed = jnp.sum(state.status == ERRORED, dtype=jnp.int64)
     state = state._replace(status=jnp.where(
         state.status == ERRORED, I32(DEAD), state.status))
 
@@ -638,6 +732,81 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
 
     new_state = state_b._replace(pc=pc_final, status=status_final)
     new_planes = planes_b._replace(fork_cond=fcond_final)
+
+    # ---- telemetry accumulation (statically compiled out when off) ------------------
+    tel = sched.telemetry
+    if tel is not None:
+        one = jnp.int64(1)
+        op_hist = tel.op_hist.at[
+            jnp.where(running, OP_CLASS_T[op], N_OP_CLASSES)].add(
+            one, mode="drop")
+
+        # escape cause: where-chain generic -> specific, so the most
+        # specific matching cause wins; scatter-add over escaping lanes
+        cause = jnp.full(batch, N_ESC_CAUSES, dtype=I32)
+        # cause names live in a local so the tuple below is (mask, name)
+        # pairs of NAMES — not a literal the opcode-parity lint would
+        # read as mnemonic references
+        cause_masks = (
+            (force_escape, "host_op"),
+            ((running & (is_op("SHA3") | is_op("RETURN")
+                         | is_op("REVERT"))
+              & (sym1 == 0) & (sym2 == 0) & mem_region_sym)
+             | (running & (is_op("CODECOPY") | is_op("RETURNDATACOPY"))
+                & _range_has_sym(planes.mem_sym, off_i,
+                                 jnp.clip(copy_size_i, 0, mem_cap),
+                                 mem_cap))
+             | (running & is_op("MCOPY")
+                & jnp.any(planes.mem_sym != 0, axis=1)),
+             "sym_mem_region"),
+            ((sload_mask | sstore_mask) & (sym1 != 0),
+             "sym_storage_key"),
+            (mload_dirty, "dirty_mload"),
+            ((running & (is_op("MSTORE") | is_op("MLOAD"))
+              & (sym1 != 0)) | cdl_sym_off, "sym_mem_off"),
+            (jumpi_host, "detector_branch"),
+            (running & (is_op("JUMP") | is_op("JUMPI")) & (sym1 != 0),
+             "sym_jump_dest"),
+            (esc_always, "halt"))
+        for mask, name in cause_masks:
+            cause = jnp.where(mask, I32(ESC_CAUSE_NAMES.index(name)), cause)
+        esc_cause = tel.esc_cause.at[
+            jnp.where(force_escape, cause, N_ESC_CAUSES)].add(
+            one, mode="drop")
+
+        lc_deltas = jnp.stack([
+            n_taken.astype(jnp.int64),                        # reseeds
+            n_err_freed,                                      # err_deaths
+            jnp.sum(overflow, dtype=jnp.int64),               # overflow_kills
+            jnp.sum(act & ~dest_ok, dtype=jnp.int64),         # bad_jump_deaths
+            jnp.sum(put, dtype=jnp.int64),                    # esc_buffered
+            jnp.sum(esc_now & ~put, dtype=jnp.int64),         # esc_frozen
+            jnp.sum(want & ~act, dtype=jnp.int64),            # fork_waits
+            jnp.sum(sload_cold, dtype=jnp.int64),             # cold_sloads
+            jnp.sum(have_target, dtype=jnp.int64),            # forks_claimed
+            jnp.sum(push, dtype=jnp.int64),                   # forks_pushed
+            jnp.sum(spill, dtype=jnp.int64),                  # forks_spilled
+            jnp.sum(frozen_fork & act, dtype=jnp.int64),      # frozen_revived
+        ])
+
+        occupancy = tel.occupancy + jnp.stack(
+            [jnp.sum(running, dtype=jnp.int64), one])
+        hwm = jnp.maximum(tel.hwm, jnp.stack(
+            [sched.stack_top.astype(jnp.int64),
+             sched.esc_count.astype(jnp.int64)]))
+        # per merge-tag / loop-header occupancy: running lanes whose fetch
+        # pc sits at a tagged address (state.pc is the pre-step pc here)
+        if tel.tag_pcs.shape[0]:
+            tag_occ = tel.tag_occ + jnp.sum(
+                running[:, None]
+                & (state.pc[:, None] == tel.tag_pcs[None, :]),
+                axis=0, dtype=jnp.int64)
+        else:
+            tag_occ = tel.tag_occ
+        sched = sched._replace(telemetry=tel._replace(
+            op_hist=op_hist, lifecycle=tel.lifecycle + lc_deltas,
+            esc_cause=esc_cause, occupancy=occupancy, hwm=hwm,
+            tag_occ=tag_occ))
 
     return new_state, new_planes, arena, sched
 

@@ -3,8 +3,9 @@
 ``POST /`` with one protocol request object as the JSON body returns the
 reply as the JSON response body — the same validation, admission, and
 isolation as the socket path, because every request still goes through
-``AnalysisService.handle``. ``GET /healthz`` answers a ping without
-touching the engine. This is deliberately a shim, not a web framework:
+``AnalysisService.handle``. ``GET /healthz`` answers a metrics
+summary (uptime, request counters, warm buckets, frontier telemetry
+rollup) without touching the engine. This is deliberately a shim, not a web framework:
 stdlib ``http.server`` only, one process, no TLS — put a real proxy in
 front if this ever leaves localhost.
 """
@@ -44,7 +45,7 @@ class _Handler(BaseHTTPRequestHandler):
                 None, "bad_request", "GET supports /healthz only"))
             return
         reply = self.service.handle(
-            protocol.Request("ping", "healthz", {}))
+            protocol.Request("healthz", "healthz", {}))
         self._reply(200, reply)
 
     def do_POST(self):

@@ -41,7 +41,7 @@ from typing import Dict, Iterator, List, Optional
 #: of hex); 8 MiB leaves room for huge inits while bounding a hostile peer
 MAX_LINE_BYTES = 8 << 20
 
-OPS = ("analyze", "ping", "status", "shutdown")
+OPS = ("analyze", "ping", "status", "shutdown", "healthz")
 
 STRATEGIES = ("dfs", "bfs", "naive-random", "weighted-random",
               "beam-search", "pending")

@@ -42,6 +42,17 @@ from ..support import tpu_config
 
 log = logging.getLogger(__name__)
 
+#: frontier telemetry counters rolled up per request and in /healthz
+#: (declared in observe/metrics.py, fed by parallel/frontier.py's
+#: per-chunk decode of the device counter plane)
+_FRONTIER_COUNTERS = ("executed", "forks", "escapes", "reseeds", "deaths",
+                      "cold_sload_pauses")
+
+
+def _frontier_counters() -> Dict[str, int]:
+    return {name: int(metrics.value(f"frontier.telemetry.{name}"))
+            for name in _FRONTIER_COUNTERS}
+
 
 class _RequestArgs:
     """Namespace handed to MythrilAnalyzer as cmd_args (it getattr()s
@@ -109,6 +120,8 @@ class AnalysisService:
         if request.op == "ping":
             return protocol.ok_reply(request.id, pong=True,
                                      uptime_s=round(self.uptime_s(), 3))
+        if request.op == "healthz":
+            return self._healthz(request)
         if request.op == "status":
             return self._status(request)
         if request.op == "shutdown":
@@ -126,6 +139,23 @@ class AnalysisService:
                 return self._analyze(request)
         finally:
             self._gate.release()
+
+    def _healthz(self, request) -> Dict:
+        """Liveness probe with a metrics summary (GET /healthz): uptime,
+        request counters, warm-bucket totals, and the lifetime frontier
+        telemetry rollup — a dashboard scrape's worth, without the full
+        ``status`` payload (metrics snapshot, verdict cache)."""
+        return protocol.ok_reply(
+            request.id,
+            healthy=True,
+            uptime_s=round(self.uptime_s(), 3),
+            requests_served=self._requests_done,
+            busy_rejections=int(metrics.value("serve.busy_rejections")),
+            request_errors=int(metrics.value("serve.request_errors")),
+            warm={"cold_buckets": int(metrics.value("xla.bucket_compiles")),
+                  "warm_hits": int(metrics.value("xla.bucket_reuses")),
+                  "warmset": self.warmset.status()},
+            frontier=_frontier_counters())
 
     def _status(self, request) -> Dict:
         from ..smt.solver import dispatch
@@ -145,6 +175,7 @@ class AnalysisService:
         started = time.monotonic()
         cold_before = metrics.value("xla.bucket_compiles")
         warm_before = metrics.value("xla.bucket_reuses")
+        frontier_before = _frontier_counters()
         with trace.span("serve.request",
                         request_id=str(request.id)) as span:
             try:
@@ -161,8 +192,12 @@ class AnalysisService:
                     f"{type(error).__name__}: {error}")
             cold = metrics.value("xla.bucket_compiles") - cold_before
             warm = metrics.value("xla.bucket_reuses") - warm_before
+            frontier = {name: value - frontier_before[name]
+                        for name, value in _frontier_counters().items()}
             span.set(cold_buckets=cold, warm_hits=warm,
-                     issues=payload["issue_count"])
+                     issues=payload["issue_count"],
+                     frontier_executed=frontier["executed"],
+                     frontier_forks=frontier["forks"])
         elapsed_ms = (time.monotonic() - started) * 1000.0
         metrics.inc("serve.requests")
         metrics.observe("serve.request_ms", elapsed_ms)
@@ -172,6 +207,7 @@ class AnalysisService:
             request.id,
             elapsed_ms=round(elapsed_ms, 3),
             warm={"cold_buckets": cold, "warm_hits": warm},
+            frontier=frontier,
             **payload)
 
     def _run_analysis(self, params: Dict) -> Dict:

@@ -52,6 +52,9 @@ class Args:
         #: analysis/module_screen.py); --no-taint turns all consumers
         #: off for A/B measurement
         self.taint = True
+        #: device-resident frontier counter plane (parallel/symstep.py);
+        #: --no-frontier-telemetry compiles it out for A/B measurement
+        self.frontier_telemetry = True
         self.sparse_pruning = True
         self.enable_state_merging = False
         self.enable_summaries = False

@@ -121,6 +121,16 @@ _KNOBS: List[Knob] = [
     Knob("MYTHRIL_TPU_TRACE_BUFFER", "int", 65536,
          "Span-tracer ring-buffer capacity in events; beyond it the "
          "oldest events drop (counted in the export)."),
+    Knob("MYTHRIL_TPU_FRONTIER_TELEMETRY", "flag", True,
+         "Arm the device-resident frontier counter plane (opcode-class "
+         "histogram, lane lifecycle, escape causes, tag occupancy) — "
+         "decoded per chunk into metrics and Perfetto counter tracks; "
+         "the --no-frontier-telemetry CLI flag also compiles it out for "
+         "A/B runs."),
+    Knob("MYTHRIL_TPU_METRICS", "str", None,
+         "Write an fsync-atomic JSON metrics snapshot to this path when "
+         "the analysis finishes; `analyze --metrics-out` sets the same "
+         "path."),
     # -- static control-flow analysis (mythril_tpu/staticanalysis/) ---------------
     Knob("MYTHRIL_TPU_CFA", "flag", True,
          "Build static CFA tables (CFG, post-dominator merge points, "

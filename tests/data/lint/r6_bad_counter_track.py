@@ -1,0 +1,15 @@
+"""R6 bad fixture: a counter-track decode loop publishing per-chunk
+telemetry deltas under metric names missing from the observe registry —
+the shape parallel/frontier.py's _publish_telemetry has, with typo'd /
+undeclared names."""
+
+from mythril_tpu.observe import metrics, trace
+
+
+def publish_chunk(op_deltas, lifecycle, running):
+    metrics.inc("frontier.telemetry.excuted", int(op_deltas.sum()))
+    metrics.set_gauge("frontier.telemetry.occupancy_pct", running)
+    for name, count in lifecycle.items():
+        # dynamic label is fine; the literal metric name here is not
+        metrics.observe("frontier.telemtry.lifecycle", count, label=name)
+    trace.counter("frontier.lanes", running=running)  # not a metric: ok

@@ -1,0 +1,237 @@
+"""Device-resident frontier telemetry plane (parallel/symstep.py):
+
+* decode parity — the on-device opcode-class histogram and lifecycle
+  totals must equal a host replay of the same concrete bytecode through
+  the SAME classification table (``symstep.OP_CLASS``);
+* tag occupancy — lanes sitting at an annotated merge/loop pc are
+  counted per chunk;
+* the telemetry-off null — compiling the plane out must not change the
+  number of host syncs (``jax.device_get`` calls) or the detections;
+* the overhead budget (slow) — stress-bench device step rate with
+  telemetry on stays within 5% of telemetry-off.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("MYTHRIL_TPU_LANES", "16")
+
+jax = pytest.importorskip("jax")
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mythril_tpu.parallel import arena as parena
+from mythril_tpu.parallel import batch as pbatch
+from mythril_tpu.parallel import symstep
+from mythril_tpu.smt.solver import sat
+
+pytestmark = pytest.mark.skipif(not sat.have_native(),
+                                reason="native CDCL build required")
+
+#: straight-line concrete body: PUSH1 5; PUSH1 10; ADD; PUSH1 0; MSTORE;
+#: PUSH1 3; PUSH1 7; LT; POP; PUSH1 1; DUP1; SWAP1; POP; POP; STOP —
+#: no jumps, so the host replay is a static walk of the byte stream
+STRAIGHT_LINE = bytes.fromhex(
+    "6005" "600a" "01" "6000" "52"
+    "6003" "6007" "10" "50"
+    "6001" "80" "90" "50" "50" "00")
+
+
+def host_replay_histogram(code: bytes) -> np.ndarray:
+    """Walk a jump-free byte stream exactly as one device lane executes
+    it, counting per opcode class via the shared symstep.OP_CLASS table.
+    The halting op (STOP here) is a counted step: the lane is RUNNING
+    when it executes it."""
+    hist = np.zeros(symstep.N_OP_CLASSES, dtype=np.int64)
+    pc = 0
+    while pc < len(code):
+        op = code[pc]
+        hist[symstep.OP_CLASS[op]] += 1
+        if op == 0x00:  # STOP — lane leaves RUNNING after this step
+            break
+        pc += 1 + (op - 0x5F if 0x60 <= op <= 0x7F else 0)
+    return hist
+
+
+def _device_run(code: bytes, n_lanes: int, n_steps: int, tag_pcs=None):
+    """run_chunk with a telemetry-armed scheduler; returns the final
+    scheduler (telemetry words still on device until np.asarray)."""
+    specs = [pbatch.LaneSpec(code, gas_limit=2 ** 40)
+             for _ in range(n_lanes)]
+    state = pbatch.build_batch(specs, stack_slots=16, memory_bytes=128,
+                               calldata_bytes=64, retdata_bytes=32,
+                               storage_slots=8, tstore_slots=2)
+    planes = symstep.SymPlanes.empty(n_lanes, 16, 128, 8, max_conds=8)
+    arena = parena.new_arena(capacity=1 << 10, const_capacity=1 << 6)
+    telemetry = symstep.new_telemetry(tag_pcs or [])
+    sched = symstep.new_scheduler(state, planes, 2 * n_lanes, 2 * n_lanes,
+                                  telemetry=telemetry)
+    state, planes, arena, sched = symstep.run_chunk(
+        state, planes, arena, sched, n_steps)
+    return sched
+
+
+def _decode(sched):
+    """Slice the packed telemetry words exactly as frontier's decode
+    does (op_hist | lifecycle | esc_cause | occupancy | hwm | tag_occ)."""
+    words = np.asarray(symstep.telemetry_words(sched.telemetry),
+                       dtype=np.int64)
+    n_op, n_lc = symstep.N_OP_CLASSES, symstep.N_LIFECYCLE
+    n_ec = symstep.N_ESC_CAUSES
+    return {
+        "op_hist": words[:n_op],
+        "lifecycle": dict(zip(symstep.LIFECYCLE_NAMES,
+                              words[n_op:n_op + n_lc])),
+        "esc_cause": dict(zip(symstep.ESC_CAUSE_NAMES,
+                              words[n_op + n_lc:n_op + n_lc + n_ec])),
+        "occupancy": words[n_op + n_lc + n_ec:n_op + n_lc + n_ec + 2],
+        "hwm": words[n_op + n_lc + n_ec + 2:symstep.TELEMETRY_FIXED_WORDS],
+        "tag_occ": words[symstep.TELEMETRY_FIXED_WORDS:],
+    }
+
+
+def test_opcode_histogram_matches_host_replay():
+    """Every lane executes the identical straight-line sequence, so the
+    device histogram must be the host replay times the lane count — and
+    the lifecycle totals must show every lane escaping at the STOP."""
+    n_lanes = 8
+    expected = host_replay_histogram(STRAIGHT_LINE)
+    sched = _device_run(STRAIGHT_LINE, n_lanes, n_steps=32)
+    tel = _decode(sched)
+
+    np.testing.assert_array_equal(tel["op_hist"], expected * n_lanes)
+    # executed total parity with the scheduler's own exact counter
+    assert tel["op_hist"].sum() == int(sched.executed) \
+        == expected.sum() * n_lanes
+    # all lanes halted at the STOP: escaped (cause: halt), none died
+    assert tel["esc_cause"]["halt"] == n_lanes
+    assert tel["lifecycle"]["esc_buffered"] \
+        + tel["lifecycle"]["esc_frozen"] == n_lanes
+    assert tel["lifecycle"]["err_deaths"] == 0
+    assert tel["lifecycle"]["overflow_kills"] == 0
+    # occupancy: lane-step sum / step count = mean running lanes;
+    # the run is front-loaded (all lanes live for len(sequence) steps)
+    lane_steps, steps = tel["occupancy"]
+    assert steps == 32
+    assert lane_steps == expected.sum() * n_lanes
+
+
+def test_tag_occupancy_counts_lanes_at_annotated_pcs():
+    """Lanes at a tagged merge/loop pc are counted each step they sit
+    there. Tag pc 2 is the PUSH1 10 at offset 2 of the straight line —
+    every lane passes it exactly once."""
+    n_lanes = 4
+    sched = _device_run(STRAIGHT_LINE, n_lanes, n_steps=32,
+                        tag_pcs=[2, 0x7F])  # second tag never reached
+    tel = _decode(sched)
+    np.testing.assert_array_equal(tel["tag_occ"], [n_lanes, 0])
+
+
+def test_telemetry_off_scheduler_has_no_plane():
+    """telemetry=None compiles the counters out entirely: the default
+    scheduler carries no telemetry pytree and run_chunk leaves it None
+    (the static-gating contract — off is a different jit program, not a
+    masked one)."""
+    specs = [pbatch.LaneSpec(STRAIGHT_LINE, gas_limit=2 ** 40)
+             for _ in range(4)]
+    state = pbatch.build_batch(specs, stack_slots=16, memory_bytes=128,
+                               calldata_bytes=64, retdata_bytes=32,
+                               storage_slots=8, tstore_slots=2)
+    planes = symstep.SymPlanes.empty(4, 16, 128, 8, max_conds=8)
+    arena = parena.new_arena(capacity=1 << 10, const_capacity=1 << 6)
+    sched = symstep.new_scheduler(state, planes, 8, 8)
+    assert sched.telemetry is None
+    *_, sched = symstep.run_chunk(state, planes, arena, sched, 4)
+    assert sched.telemetry is None
+
+
+def _analyze_killbilly(engine_flag: bool, monkeypatch):
+    """One KILLBILLY device-engine run with the telemetry flag forced,
+    counting every jax.device_get host sync. Returns (sync_count,
+    canonical detection list)."""
+    from test_analysis import KILLBILLY
+
+    from mythril_tpu.analysis.security import (fire_lasers,
+                                               reset_callback_modules)
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.frontends.asm import (assemble, creation_wrapper,
+                                           dispatcher)
+    from mythril_tpu.support.support_args import args as support_args
+
+    monkeypatch.setattr(support_args, "frontier_telemetry", engine_flag)
+    syncs = [0]
+    real_device_get = jax.device_get
+
+    def counting_device_get(x):
+        syncs[0] += 1
+        return real_device_get(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_device_get)
+    reset_callback_modules()
+    creation = creation_wrapper(assemble(dispatcher(KILLBILLY)))
+    wrapper = SymExecWrapper(
+        creation.hex(), address=None, strategy="bfs", max_depth=128,
+        execution_timeout=240, create_timeout=30, transaction_count=2,
+        modules=["AccidentallyKillable"], compulsory_statespace=False,
+        engine="tpu")
+    issues = fire_lasers(wrapper, white_list=["AccidentallyKillable"])
+    detections = sorted(
+        (issue.swc_id, issue.address, issue.function,
+         [step.get("input") for step in
+          issue.transaction_sequence["steps"]])
+        for issue in issues)
+    return syncs[0], detections
+
+
+def test_telemetry_off_null(monkeypatch):
+    """The A/B contract: telemetry rides the existing per-chunk summary
+    download, so turning it off changes NEITHER the host-sync count nor
+    the detections — byte-identical issues either way."""
+    syncs_on, detections_on = _analyze_killbilly(True, monkeypatch)
+    syncs_off, detections_off = _analyze_killbilly(False, monkeypatch)
+    assert detections_on == detections_off
+    assert [d[0] for d in detections_on] == ["106"]
+    assert syncs_on == syncs_off
+
+
+@pytest.mark.slow
+def test_telemetry_overhead_within_budget():
+    """Acceptance: stress-bench device step rate with telemetry on
+    within 5% of telemetry-off. Uses the fused-chunk stress shape
+    directly (forky dispatcher code, big lane count) so the measurement
+    is the device step loop, not host services."""
+    import time
+
+    import __graft_entry__ as graft
+
+    n_lanes = 512
+    chunk = 256
+
+    def rate(with_telemetry: bool) -> float:
+        state, planes = graft._symbolic_batch(n_lanes)
+        arena = parena.new_arena(capacity=1 << 14, const_capacity=1 << 8)
+        telemetry = symstep.new_telemetry([2, 9]) if with_telemetry \
+            else None
+        sched = symstep.new_scheduler(state, planes, 4 * n_lanes,
+                                      4 * n_lanes, telemetry=telemetry)
+        # compile outside the measured window
+        out = symstep.run_chunk(state, planes, arena, sched, chunk)
+        jax.block_until_ready(out[0].status)
+        best = 0.0
+        for _ in range(3):
+            start = time.perf_counter()
+            out = symstep.run_chunk(state, planes, arena, sched, chunk)
+            jax.block_until_ready(out[0].status)
+            best = max(best, chunk * n_lanes
+                       / (time.perf_counter() - start))
+        return best
+
+    rate_off = rate(False)
+    rate_on = rate(True)
+    assert rate_on >= 0.95 * rate_off, (
+        f"telemetry overhead over budget: {rate_on:.0f} vs "
+        f"{rate_off:.0f} lane-steps/s ({rate_on / rate_off:.1%})")

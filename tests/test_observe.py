@@ -118,6 +118,36 @@ def test_export_is_valid_perfetto_trace_event_json(tmp_path):
     assert doc["otherData"]["total_events"] == 3
 
 
+def test_counter_track_emission(tmp_path):
+    """'C' counter samples: each kwarg is one series on the named track —
+    ts + args only (no dur, no instant scope), the shape Perfetto renders
+    as counter tracks and tools/frontierview.py sums per chunk."""
+    out = str(tmp_path / "counters.json")
+    trace.enable(out)
+    trace.counter("frontier.lanes", running=14, stack=2, escaped=0)
+    trace.counter("frontier.lanes", running=9, stack=5, escaped=4)
+    trace.counter("frontier.arena", nodes=12)
+    doc = json.load(open(trace.export()))
+    counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert [e["name"] for e in counters] == [
+        "frontier.lanes", "frontier.lanes", "frontier.arena"]
+    for event in counters:
+        assert isinstance(event["ts"], (int, float))
+        assert "dur" not in event and "s" not in event
+        assert event["cat"] == "frontier"
+    assert counters[0]["args"] == {"running": 14, "stack": 2, "escaped": 0}
+    assert counters[1]["args"] == {"running": 9, "stack": 5, "escaped": 4}
+    assert counters[2]["args"] == {"nodes": 12}
+    # samples on the same track are time-ordered
+    assert counters[0]["ts"] <= counters[1]["ts"]
+
+
+def test_counter_is_noop_when_disabled():
+    assert not trace.enabled()
+    trace.counter("frontier.lanes", running=1)  # must not raise or record
+    assert trace.export() is None
+
+
 def test_env_knob_enables_tracer_at_first_use(tmp_path, monkeypatch):
     out = str(tmp_path / "env.json")
     monkeypatch.setenv("MYTHRIL_TPU_TRACE", out)
@@ -219,6 +249,22 @@ def test_snapshot_shape_and_prefix_reset():
     assert metrics.value("dispatch.flushes") == 0
     assert metrics.histogram("dispatch.flush.occupancy") is None
     assert metrics.value("frontier.chunks") == 5  # other prefixes untouched
+
+
+def test_write_snapshot_is_atomic_json(tmp_path):
+    """write_snapshot: valid JSON of the full snapshot, written via a
+    temp file + os.replace so a crashed writer never leaves a torn
+    file at the destination path."""
+    metrics.inc("frontier.telemetry.executed", 122)
+    metrics.set_gauge("frontier.telemetry.occupancy", 4.5)
+    metrics.observe("frontier.telemetry.op_class", 44, label="push")
+    path = str(tmp_path / "metrics.json")
+    metrics.write_snapshot(path)
+    snap = json.load(open(path))
+    assert snap["frontier.telemetry.executed"] == 122
+    assert snap["frontier.telemetry.occupancy"] == 4.5
+    assert snap["frontier.telemetry.op_class"]["push"]["sum"] == 44
+    assert not os.path.exists(path + ".tmp")  # replaced, not left behind
 
 
 def test_every_facade_field_is_declared():

@@ -25,6 +25,11 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu \
     python -m tools.taint_smoke || exit $?
 
 echo
+echo "== frontierview smoke (jax-free counter-track report) =="
+timeout -k 10 60 python -m tools.frontierview \
+    tests/data/trace/frontier_trace.json > /dev/null || exit $?
+
+echo
 echo "== serve smoke (daemon start -> request -> clean shutdown) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu \
     python -m tools.serve_smoke || exit $?

@@ -1,0 +1,280 @@
+"""Frontier-telemetry CLI for mythril-tpu traces and metrics snapshots.
+
+    python -m tools.frontierview TRACE.json [--metrics METRICS.json]
+
+Reads the Perfetto counter ('C') tracks that the device-resident
+frontier telemetry plane emits per chunk (``parallel/frontier.py``
+decodes the packed counter words riding the existing summary download
+and samples them via ``observe/trace.py``'s counter API) and prints:
+
+* the **lane-occupancy timeline** — one row per chunk with running /
+  DFS-stack / escaped lane counts (``frontier.lanes``) and arena fill
+  (``frontier.arena``) as stacked text bars;
+* the **opcode-class heatmap** — total per-class executed-instruction
+  counts across the run (``frontier.ops``), ranked;
+* the **escape/prune cause table** — why lanes left the device
+  (``frontier.causes``) and the lifecycle totals — reseeds, deaths,
+  fork waits, cold-SLOAD pauses (``frontier.lifecycle``);
+* **per-loop / per-merge-tag occupancy** (``frontier.tags``): how many
+  lane-steps ran at each ``loop@pc`` / ``merge@pc`` site the static
+  analysis annotated.
+
+With ``--metrics`` it also summarizes an fsync-atomic metrics snapshot
+(``analyze --metrics-out`` / ``MYTHRIL_TPU_METRICS`` /
+``observe.metrics.write_snapshot``): the ``frontier.telemetry.*``
+counters, gauges, and labeled histograms.
+
+Stdlib-only (json/argparse): usable on a workstation without jax.
+Exit codes: 0 on success (even when the trace has no counter tracks —
+the report says so), 2 when a file is missing or malformed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: bar width for the occupancy timeline and heatmap bars
+_BAR = 40
+
+#: the counter tracks the frontier decode emits (observe/trace.py)
+LANES_TRACK = "frontier.lanes"
+ARENA_TRACK = "frontier.arena"
+OPS_TRACK = "frontier.ops"
+CAUSES_TRACK = "frontier.causes"
+LIFECYCLE_TRACK = "frontier.lifecycle"
+TAGS_TRACK = "frontier.tags"
+
+
+def load_trace(path: str) -> Tuple[List[dict], Dict[str, object]]:
+    """Parse a trace_event document (object or bare-array format) —
+    same acceptance as tools/traceview.py. Raises ValueError."""
+    with open(path, "r", encoding="utf-8") as handle:
+        doc = json.load(handle)
+    if isinstance(doc, list):
+        events, other = doc, {}
+    elif isinstance(doc, dict) and isinstance(doc.get("traceEvents"), list):
+        events, other = doc["traceEvents"], dict(doc.get("otherData") or {})
+    else:
+        raise ValueError(
+            "not a trace_event document: expected a JSON array of events "
+            "or an object with a 'traceEvents' list")
+    for event in events:
+        if not isinstance(event, dict) or "ph" not in event:
+            raise ValueError("malformed trace event (no 'ph' field): "
+                             f"{event!r:.120}")
+    return events, other
+
+
+def counter_samples(events: List[dict], track: str) -> List[dict]:
+    """Time-ordered 'C' samples for one counter track: each a dict of
+    {ts (us), values {series: number}}."""
+    samples = []
+    for event in events:
+        if event.get("ph") != "C" or event.get("name") != track:
+            continue
+        values = {}
+        for key, value in (event.get("args") or {}).items():
+            if isinstance(value, (int, float)):
+                values[key] = value
+        samples.append({"ts": float(event.get("ts", 0.0)), "values": values})
+    samples.sort(key=lambda s: s["ts"])
+    return samples
+
+
+def sum_series(samples: List[dict]) -> Dict[str, float]:
+    """Per-series totals across samples (the tracks carry per-chunk
+    deltas, so the sum is the run total)."""
+    totals: Dict[str, float] = {}
+    for sample in samples:
+        for key, value in sample["values"].items():
+            totals[key] = totals.get(key, 0.0) + value
+    return totals
+
+
+def _fmt_ts(us: float) -> str:
+    if us < 1_000_000:
+        return f"{us / 1_000:.1f}ms"
+    return f"{us / 1_000_000:.2f}s"
+
+
+def _bar(value: float, peak: float, width: int = _BAR) -> str:
+    if peak <= 0:
+        return ""
+    return "#" * max(1 if value > 0 else 0,
+                     int(round(value / peak * width)))
+
+
+def _ranked_table(totals: Dict[str, float], title: str,
+                  unit: str) -> List[str]:
+    lines = ["", f"== {title} =="]
+    total = sum(totals.values())
+    if total <= 0:
+        lines.append("  (no samples)")
+        return lines
+    peak = max(totals.values())
+    for name, value in sorted(totals.items(), key=lambda kv: -kv[1]):
+        if value <= 0:
+            continue
+        share = value / total * 100
+        lines.append(f"  [{share:5.1f}%] {name:<16} {value:>12.0f} {unit}  "
+                     f"|{_bar(value, peak):<{_BAR}}|")
+    return lines
+
+
+def _timeline_section(lanes: List[dict], arena: List[dict]) -> List[str]:
+    lines = ["", "== lane-occupancy timeline (per chunk) =="]
+    if not lanes:
+        lines.append("  (no frontier.lanes samples — telemetry off or "
+                     "host engine)")
+        return lines
+    arena_at = {s["ts"]: s["values"].get("nodes", 0) for s in arena}
+    arena_ts = sorted(arena_at)
+    peak = max(max(s["values"].get("running", 0),
+                   s["values"].get("stack", 0),
+                   s["values"].get("escaped", 0)) for s in lanes) or 1
+    lines.append(f"  {len(lanes)} chunk(s); bar scale: {peak:.0f} lanes "
+                 "(r=running, s=DFS stack, e=escaped)")
+    for sample in lanes:
+        values = sample["values"]
+        running = values.get("running", 0)
+        stack = values.get("stack", 0)
+        escaped = values.get("escaped", 0)
+        # nearest arena sample at-or-before this chunk's timestamp
+        nodes = 0
+        for ts in arena_ts:
+            if ts <= sample["ts"]:
+                nodes = arena_at[ts]
+            else:
+                break
+        lines.append(
+            f"  @{_fmt_ts(sample['ts']):>9}  "
+            f"r{running:>5.0f} |{_bar(running, peak, 14):<14}| "
+            f"s{stack:>5.0f} |{_bar(stack, peak, 14):<14}| "
+            f"e{escaped:>5.0f} |{_bar(escaped, peak, 14):<14}| "
+            f"arena {nodes:.0f}")
+    return lines
+
+
+def _lifecycle_section(totals: Dict[str, float]) -> List[str]:
+    lines = ["", "== lane lifecycle (run totals) =="]
+    if not totals:
+        lines.append("  (no frontier.lifecycle samples)")
+        return lines
+    for name in sorted(totals):
+        lines.append(f"  {name:<16} {totals[name]:>12.0f}")
+    return lines
+
+
+def _tags_section(totals: Dict[str, float]) -> List[str]:
+    lines = ["", "== per-loop / per-merge-tag occupancy (lane-steps) =="]
+    if not totals:
+        lines.append("  (no frontier.tags samples — contract had no "
+                     "annotated loop headers or merge points)")
+        return lines
+    peak = max(totals.values()) or 1
+    for name, value in sorted(totals.items(), key=lambda kv: -kv[1]):
+        lines.append(f"  {name:<16} {value:>12.0f}  "
+                     f"|{_bar(value, peak):<{_BAR}}|")
+    return lines
+
+
+def report(events: List[dict], other: Dict[str, object]) -> str:
+    lines: List[str] = ["== frontier telemetry =="]
+    for key in ("engine", "contracts", "started_at"):
+        if key in other:
+            lines.append(f"  {key}: {other[key]}")
+    lanes = counter_samples(events, LANES_TRACK)
+    arena = counter_samples(events, ARENA_TRACK)
+    ops = sum_series(counter_samples(events, OPS_TRACK))
+    causes = sum_series(counter_samples(events, CAUSES_TRACK))
+    lifecycle = sum_series(counter_samples(events, LIFECYCLE_TRACK))
+    tags = sum_series(counter_samples(events, TAGS_TRACK))
+    n_counter = sum(1 for e in events if e.get("ph") == "C")
+    lines.append(f"  counter samples: {n_counter} "
+                 f"({len(lanes)} chunk(s) with lane telemetry)")
+    if not n_counter:
+        lines.append("  hint: run with --trace-out and the frontier "
+                     "telemetry knob on (MYTHRIL_TPU_FRONTIER_TELEMETRY, "
+                     "default 1) and --engine tpu")
+    lines.extend(_timeline_section(lanes, arena))
+    lines.extend(_ranked_table(ops, "opcode-class heatmap (executed)",
+                               "ops"))
+    lines.extend(_ranked_table(causes, "escape/prune causes", "lanes"))
+    lines.extend(_lifecycle_section(lifecycle))
+    lines.extend(_tags_section(tags))
+    return "\n".join(lines)
+
+
+def metrics_report(snapshot: Dict[str, object]) -> str:
+    """Summarize the frontier.telemetry.* slice of a metrics snapshot
+    (observe.metrics.write_snapshot / --metrics-out)."""
+    lines = ["", "== metrics snapshot (frontier.telemetry.*) =="]
+    rows = {name: value for name, value in snapshot.items()
+            if str(name).startswith("frontier.telemetry.")}
+    if not rows:
+        lines.append("  (snapshot has no frontier.telemetry entries)")
+        return "\n".join(lines)
+    for name in sorted(rows):
+        value = rows[name]
+        short = name[len("frontier.telemetry."):]
+        if isinstance(value, dict) and value and all(
+                isinstance(v, dict) for v in value.values()):
+            # labeled histogram: {label: {count, sum, ...}}
+            lines.append(f"  {short}:")
+            for label, stats in sorted(
+                    value.items(),
+                    key=lambda kv: -float(kv[1].get("sum", 0) or 0)):
+                lines.append(f"    {label:<16} sum {stats.get('sum', 0):>12} "
+                             f" x{stats.get('count', 0)}")
+        elif isinstance(value, dict):
+            detail = ", ".join(f"{k}={v}" for k, v in sorted(value.items()))
+            lines.append(f"  {short:<24} {detail}")
+        else:
+            lines.append(f"  {short:<24} {value}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.frontierview",
+        description="frontier-telemetry report (occupancy timeline, "
+                    "opcode heatmap, escape causes, tag occupancy) for a "
+                    "mythril-tpu trace")
+    parser.add_argument("trace", nargs="?", default=None,
+                        help="trace_event JSON written via "
+                             "MYTHRIL_TPU_TRACE / --trace-out")
+    parser.add_argument("--metrics", default=None, metavar="PATH",
+                        help="metrics snapshot JSON written via "
+                             "--metrics-out / MYTHRIL_TPU_METRICS")
+    args = parser.parse_args(argv)
+    if not args.trace and not args.metrics:
+        parser.error("need a trace file, --metrics PATH, or both")
+    out: List[str] = []
+    if args.trace:
+        try:
+            events, other = load_trace(args.trace)
+        except (OSError, ValueError, json.JSONDecodeError) as error:
+            print(f"frontierview: cannot read {args.trace}: {error}",
+                  file=sys.stderr)
+            return 2
+        out.append(report(events, other))
+    if args.metrics:
+        try:
+            with open(args.metrics, "r", encoding="utf-8") as handle:
+                snapshot = json.load(handle)
+            if not isinstance(snapshot, dict):
+                raise ValueError("metrics snapshot must be a JSON object")
+        except (OSError, ValueError, json.JSONDecodeError) as error:
+            print(f"frontierview: cannot read {args.metrics}: {error}",
+                  file=sys.stderr)
+            return 2
+        out.append(metrics_report(snapshot))
+    print("\n".join(out).lstrip("\n"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
