@@ -185,6 +185,54 @@ def main():
         tpu_rate, tpu_info = _run_engine("tpu", seconds)
     _phase("tpu", states_per_sec=round(tpu_rate, 1), **tpu_info)
 
+    # 3b. merge A/B (README "State merging"): on the branchy 2^N shape
+    #     every fork reconverges immediately, so the merge pass retires
+    #     one sibling per fork instead of carrying duplicate suffixes.
+    #     Both sides run with a SMALL fused chunk — merge boundaries
+    #     only pair lanes sitting ON a join pc, and at the default 64
+    #     chunk length boundaries almost never land there — after a
+    #     short warm-up that compiles the chunk-4 programs off-clock.
+    ab_seconds = min(seconds, 20.0)
+    os.environ["MYTHRIL_TPU_CHUNK"] = "4"
+    try:
+        os.environ["MYTHRIL_TPU_SKIP_HOST_DRAIN"] = "1"
+        with trace.span("bench.merge_ab_warmup"):
+            _run_engine("tpu", 60)
+        del os.environ["MYTHRIL_TPU_SKIP_HOST_DRAIN"]
+        metrics.reset("frontier.merge")
+        with trace.span("bench.tpu_merge_on"):
+            on_rate, on_info = _run_engine("tpu", ab_seconds)
+        merge_snap = metrics.snapshot()
+        os.environ["MYTHRIL_TPU_STATE_MERGE"] = "0"
+        with trace.span("bench.tpu_merge_off"):
+            off_rate, off_info = _run_engine("tpu", ab_seconds)
+    finally:
+        os.environ.pop("MYTHRIL_TPU_STATE_MERGE", None)
+        os.environ.pop("MYTHRIL_TPU_SKIP_HOST_DRAIN", None)
+        del os.environ["MYTHRIL_TPU_CHUNK"]
+    # the merged run typically DRAINS the whole tree inside the budget
+    # while the unmerged run times out with the worklist still pending,
+    # so wall-clock speedup is a lower bound and the states ratio is
+    # the duplicate-suffix work the merges avoided — raw states/s would
+    # be exactly backwards here (needing fewer states is the win)
+    merge_ab = {
+        "chunk": 4,
+        "on": {"states_per_sec": round(on_rate, 1), **on_info,
+               "merge_events": int(merge_snap.get(
+                   "frontier.merge.events", 0)),
+               "lanes_retired": int(merge_snap.get(
+                   "frontier.merge.lanes_retired", 0))},
+        "off": {"states_per_sec": round(off_rate, 1), **off_info},
+        "wall_speedup": round(off_info["elapsed_s"]
+                              / max(on_info["elapsed_s"], 1e-9), 2),
+        "states_ratio": round(off_info["states"]
+                              / max(on_info["states"], 1), 2),
+    }
+    _phase("merge_ab", wall_speedup=merge_ab["wall_speedup"],
+           states_ratio=merge_ab["states_ratio"],
+           merge_events=merge_ab["on"]["merge_events"],
+           lanes_retired=merge_ab["on"]["lanes_retired"])
+
     if tpu_info["forks_on_device"] > 0 and tpu_rate > host_rate:
         trace.export()
         metrics.write_snapshot(metrics_path)
@@ -199,6 +247,7 @@ def main():
             "n_lanes": int(os.environ["MYTHRIL_TPU_LANES"]),
             "tpu": tpu_info,
             "host": host_info,
+            "merge_ab": merge_ab,
             "frontier": _frontier_rollup(),
             "corpus": _corpus_extras(),
             "trace": trace_path,
@@ -228,6 +277,7 @@ def main():
         "sym_host_states_per_sec": round(host_rate, 1),
         "sym_tpu": tpu_info,
         "sym_host": host_info,
+        "merge_ab": merge_ab,
         "frontier": _frontier_rollup(),
         "corpus": _corpus_extras(),
         "trace": trace_path,

@@ -1,11 +1,22 @@
 """Bounded-loops strategy decorator (API parity:
 mythril/laser/ethereum/strategy/extensions/bounded_loops.py:27 — trace-hash loop
-counting, prunes JUMPI targets above the loop bound)."""
+counting, prunes JUMPI targets above the loop bound).
+
+Unroll budgets are PER NATURAL LOOP where the static loop table
+(staticanalysis/summary.py via module_screen.loop_header_at) knows one:
+every arrival at a loop's header pc draws from that loop's budget, so a
+loop with several back edges no longer multiplies the global bound by
+its edge count. States materialized from the device frontier inside a
+loop (parallel/frontier.py LoopHintAnnotation) seed that loop's count
+at 1 — the device already spent at least one unroll on them. JUMPDESTs
+outside any recovered loop keep the reference's per-(source, target)
+edge counting as the fallback."""
 
 from __future__ import annotations
 
 import logging
-from typing import Dict, List
+import sys
+from typing import Dict, Set
 
 from ..state.annotation import StateAnnotation
 from ..state.global_state import GlobalState
@@ -15,20 +26,38 @@ log = logging.getLogger(__name__)
 
 
 class JumpdestCountAnnotation(StateAnnotation):
-    """Tracks executed (source, target) jump pairs per path."""
+    """Tracks executed (source, target) jump pairs and per-loop-header
+    unroll counts per path (header counts use negative keys, so the two
+    families can never collide in the one dict)."""
 
     def __init__(self):
         self._reached_count: Dict[int, int] = {}
+        #: loop headers whose count was seeded from a device LoopHint
+        self._seeded_headers: Set[int] = set()
 
     def __copy__(self):
         clone = JumpdestCountAnnotation()
         clone._reached_count = dict(self._reached_count)
+        clone._seeded_headers = set(self._seeded_headers)
         return clone
 
 
+def _loop_hint_headers(state: GlobalState) -> tuple:
+    """Header pcs of the LoopHintAnnotations riding on a device-
+    materialized state. The annotation class lives in the frontier
+    module; if that was never imported, no state can carry one."""
+    frontier = sys.modules.get("mythril_tpu.parallel.frontier")
+    if frontier is None:
+        return ()
+    return tuple(hint.header_pc for hint
+                 in state.get_annotations(frontier.LoopHintAnnotation))
+
+
 class BoundedLoopsStrategy(BasicSearchStrategy):
-    """Wraps another strategy; drops states that revisit the same jump destination
-    more than `loop_bound` times (decorator pattern, reference svm.py:148)."""
+    """Wraps another strategy; drops states that exhaust a loop's unroll
+    budget (decorator pattern, reference svm.py:148). `loop_bound` is
+    the budget of EACH recovered natural loop — and of each (source,
+    target) edge where static loop recovery has no verdict."""
 
     def __init__(self, super_strategy: BasicSearchStrategy, **kwargs):
         self.super_strategy = super_strategy
@@ -51,10 +80,32 @@ class BoundedLoopsStrategy(BasicSearchStrategy):
             else:
                 annotation = annotations[0]
             address = state.get_current_instruction()["address"]
-            source = state.mstate.prev_pc
-            key = self.calculate_hash(source, address)
-            annotation._reached_count[key] = annotation._reached_count.get(key, 0) + 1
+            header = None
+            try:
+                from ...analysis import module_screen
+
+                header = module_screen.loop_header_at(
+                    state.environment.code, address)
+            except Exception:  # no static tables for this code object
+                header = None
+            if header == address:
+                # one arrival at the header = one unroll of THIS loop,
+                # whichever back edge (or the entry edge) got us here
+                key = -header - 1
+                if header not in annotation._seeded_headers:
+                    annotation._seeded_headers.add(header)
+                    if header in _loop_hint_headers(state):
+                        # materialized mid-loop: the device frontier
+                        # already spent at least one unroll
+                        annotation._reached_count[key] = \
+                            annotation._reached_count.get(key, 0) + 1
+            else:
+                source = state.mstate.prev_pc
+                key = self.calculate_hash(source, address)
+            annotation._reached_count[key] = \
+                annotation._reached_count.get(key, 0) + 1
             if annotation._reached_count[key] > self.bound:
-                log.debug("loop bound %d exceeded at %d", self.bound, address)
+                log.debug("loop bound %d exceeded at %d", self.bound,
+                          address)
                 continue
             return state

@@ -88,6 +88,14 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
                               "histogram, lifecycle counters, or counter "
                               "tracks in the trace (A/B measurement; same "
                               "as MYTHRIL_TPU_FRONTIER_TELEMETRY=0)")
+    options.add_argument("--no-state-merge", action="store_true",
+                         help="disable on-device state merging "
+                              "(veritesting) at post-dominator join "
+                              "points: reconverged sibling lanes keep "
+                              "exploring separately instead of collapsing "
+                              "into one ITE-blended lane (A/B "
+                              "measurement; same as "
+                              "MYTHRIL_TPU_STATE_MERGE=0)")
     options.add_argument("--engine", default="host", choices=["host", "tpu"],
                          help="exploration engine: host worklist or the "
                               "batched TPU symbolic frontier")

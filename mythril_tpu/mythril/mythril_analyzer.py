@@ -65,6 +65,7 @@ class MythrilAnalyzer:
         args.taint = not getattr(cmd, "no_taint", False)
         args.frontier_telemetry = not getattr(
             cmd, "no_frontier_telemetry", False)
+        args.state_merge = not getattr(cmd, "no_state_merge", False)
         args.device_crosscheck = getattr(cmd, "device_crosscheck", 0)
         args.inject_fault = getattr(cmd, "inject_fault", None)
         solver = getattr(cmd, "solver", None)

@@ -155,6 +155,27 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("frontier.telemetry.tag_occupancy", HISTOGRAM, "1",
                "Per-chunk running-lane-steps at tagged merge-point / "
                "loop-header pcs (label = merge@pc / loop@pc)."),
+    # -- on-device state merging (parallel/symstep.py merge_pass) ----------------
+    MetricSpec("frontier.merge.passes", COUNTER, "1",
+               "Merge-pass invocations dispatched to the device "
+               "(telemetry-triggered or fixed-cadence)."),
+    MetricSpec("frontier.merge.events", COUNTER, "1",
+               "Sibling-lane pairs collapsed into one ITE-blended lane "
+               "(each event drops one path condition and retires one "
+               "lane)."),
+    MetricSpec("frontier.merge.lanes_retired", COUNTER, "1",
+               "Device lanes freed by state merging (DEAD, reclaimable "
+               "by forks and reseeds)."),
+    MetricSpec("frontier.merge.ites", COUNTER, "1",
+               "Arena ITE nodes allocated to blend differing stack / "
+               "storage slots across merged pairs."),
+    MetricSpec("frontier.merge.tag_merges", HISTOGRAM, "1",
+               "Merge events by post-dominator merge tag (label = "
+               "merge@pc; 'untagged' = reconvergence past any tagged "
+               "pc)."),
+    MetricSpec("frontier.merge.ite_depth", HISTOGRAM, "1",
+               "Merge events by blended-slot count per pair (label = "
+               "bucket, symstep.MERGE_DEPTH_LABELS)."),
     # -- checkpoints (support/checkpoint.py, parallel/frontier.py) ---------------
     MetricSpec("checkpoint.saves", COUNTER, "1",
                "Crash-safe checkpoint writes (host pickle + device npz)."),

@@ -236,6 +236,12 @@ _summary_jit = None
 _pack_rows_jit = None
 _row_maxima_jit = None
 _reset_esc_jit = None
+_merge_jit = None
+
+#: greedy pairing rounds per merge invocation — each round collapses one
+#: level of a reconverged fork subtree, so 6 rounds fold up to 64 sibling
+#: lanes per pass (deeper trees finish on the next triggered pass)
+_MERGE_ROUNDS = 6
 
 
 def _gather_rows_compiled():
@@ -292,6 +298,16 @@ def _reset_esc_compiled():
 
         _reset_esc_jit = jax.jit(_reset_esc)
     return _reset_esc_jit
+
+
+def _merge_compiled():
+    global _merge_jit
+    if _merge_jit is None:
+        import jax
+
+        _merge_jit = jax.jit(symstep.merge_pass,
+                             static_argnames=("n_rounds",))
+    return _merge_jit
 
 
 class LaneContext(A.TxContext):
@@ -439,6 +455,22 @@ class _Frontier:
         #: previous chunk's raw telemetry words (device counters are
         #: cumulative within a phase; deltas are published per chunk)
         self._tel_prev: Optional[np.ndarray] = None
+        #: on-device state merging (veritesting): collapse fork-sibling
+        #: lanes that reconverged at a post-dominator join into one lane
+        #: with ITE-blended planes (symstep.merge_pass). Knob AND the CLI
+        #: A/B flag (--no-state-merge) must both be on.
+        self.state_merge = (
+            tpu_config.get_flag("MYTHRIL_TPU_STATE_MERGE")
+            and getattr(_support_args, "state_merge", True))
+        #: merge-tag occupancy (lane-visits per chunk at one merge point)
+        #: that triggers a merge pass; the telemetry tag deltas are the
+        #: trigger signal, so with telemetry off the pass falls back to a
+        #: fixed chunk cadence
+        self.merge_min_lanes = tpu_config.get_int(
+            "MYTHRIL_TPU_MERGE_MIN_LANES", 2)
+        self.merges = 0     # pairs collapsed (one lane retired each)
+        #: last chunk's per-tag occupancy deltas (merge-pass trigger)
+        self._last_tag_delta: Optional[np.ndarray] = None
 
     def _harena(self, used=None, used_const=None) -> A.HostArena:
         """The persistent incremental host mirror of the arena (term memo
@@ -475,6 +507,7 @@ class _Frontier:
             tag_pcs, self.tag_names = self._collect_tag_pcs()
             telemetry = symstep.new_telemetry(tag_pcs)
             self._tel_prev = None  # device counters restart each phase
+            self._last_tag_delta = None
         return symstep.new_scheduler(state, planes, stack_rows, esc_rows,
                                      telemetry=telemetry)
 
@@ -515,6 +548,64 @@ class _Frontier:
                      len(tags), len(tags) + dropped, dropped,
                      self.TAG_SLOTS)
         return [pc for pc, _ in tags], [name for _, name in tags]
+
+    #: merge-attribution table cap (one P x K compare per merge round)
+    MERGE_PC_SLOTS = 64
+
+    def _merge_pc_table(self) -> Tuple[np.ndarray, List[str]]:
+        """Post-dominator merge-point pcs for merge-event attribution
+        (frontier.merge.tag_merges labels). Pairing itself keys on full
+        state equality, so joins past the cap still merge — they just
+        land in the 'untagged' bucket."""
+        pcs: List[int] = []
+        names: List[str] = []
+        seen = set()
+        for ctx in self.contexts:
+            cfa = cfa_screen.cfa_for(ctx.template.environment.code)
+            if cfa is None:
+                continue
+            for pc in sorted(cfa.merge_points):
+                if pc not in seen:
+                    seen.add(pc)
+                    pcs.append(pc)
+                    names.append(f"merge@{pc:#x}")
+        pcs, names = pcs[:self.MERGE_PC_SLOTS], names[:self.MERGE_PC_SLOTS]
+        return np.asarray(pcs, dtype=np.int32), names
+
+    def _publish_merge(self, mstats: np.ndarray,
+                       merge_names: List[str]) -> None:
+        """Decode one merge pass's stats vector (symstep.merge_pass:
+        [merges, ites, tag_hits[K], depth_hist]) into declared metrics
+        and a Perfetto counter track."""
+        fixed = symstep.MERGE_STATS_FIXED
+        n_tags = len(merge_names)
+        merges = int(mstats[0])
+        metrics.inc("frontier.merge.passes")
+        if not merges:
+            return
+        self.merges += merges
+        metrics.inc("frontier.merge.events", merges)
+        metrics.inc("frontier.merge.lanes_retired", merges)
+        metrics.inc("frontier.merge.ites", int(mstats[1]))
+        tagged = 0
+        for name, count in zip(merge_names, mstats[fixed:fixed + n_tags]):
+            if count:
+                tagged += int(count)
+                metrics.observe("frontier.merge.tag_merges", int(count),
+                                label=name)
+        if merges > tagged:
+            metrics.observe("frontier.merge.tag_merges", merges - tagged,
+                            label="untagged")
+        for name, count in zip(symstep.MERGE_DEPTH_LABELS,
+                               mstats[fixed + n_tags:]):
+            if count:
+                metrics.observe("frontier.merge.ite_depth", int(count),
+                                label=name)
+        if trace.enabled():
+            # per-pass deltas, like every frontier counter track (the
+            # viewers sum samples into run totals)
+            trace.counter("frontier.merges", merged=merges,
+                          ites=int(mstats[1]))
 
     # -- seeding -----------------------------------------------------------------------
 
@@ -688,6 +779,14 @@ class _Frontier:
             return
         sched = self._new_sched(state, planes)
         stack_rows = sched.stack_state.status.shape[0]
+        # post-dominator merge-point table (staticanalysis/ via the CFA
+        # screen): attribution labels for frontier.merge.tag_merges. The
+        # telemetry tag-occupancy deltas on these pcs are the trigger;
+        # without them the pass runs on a fixed chunk cadence.
+        merge_pc_arr, merge_names = self._merge_pc_table() \
+            if self.state_merge else (np.zeros(0, np.int32), [])
+        merge_by_tags = self.telemetry_enabled and any(
+            name.startswith("merge@") for name in self.tag_names)
         # an unsatisfiable count trigger would silently degrade every drain
         # to the frozen-ESCAPED overflow fallback
         drain_batch = min(self.drain_batch,
@@ -808,6 +907,32 @@ class _Frontier:
             if dirty:
                 state = state._replace(status=status)
                 state, planes = self._to_device(state, planes)
+            # state merging (veritesting): collapse fork-sibling lanes that
+            # reconverged after their diamond. MUST run after the dirty
+            # re-upload above — an earlier merge would be undone when the
+            # stale host-side status resurrects the retired partner. The
+            # trigger is the per-chunk merge-tag occupancy delta (>= K
+            # lane-visits at one join point); runs only while >= 2 lanes
+            # can actually pair
+            if self.state_merge and int(np.sum(status == RUNNING)) >= 2:
+                if merge_by_tags and self._last_tag_delta is not None:
+                    due = any(
+                        int(count) >= self.merge_min_lanes
+                        for name, count in zip(self.tag_names,
+                                               self._last_tag_delta)
+                        if name.startswith("merge@"))
+                else:  # telemetry off (or no tracked joins): fixed cadence
+                    due = (steps // chunk) % 4 == 0
+                if due:
+                    with trace.span("frontier.merge"):
+                        state, planes, self.arena, mstats = \
+                            _merge_compiled()(
+                                state, planes, self.arena, merge_pc_arr,
+                                n_rounds=_MERGE_ROUNDS)
+                        # one small vector download, on triggered chunks
+                        # only (the tunnel charges a ~30 ms floor)
+                        mstats = np.asarray(jax.device_get(mstats))
+                    self._publish_merge(mstats, merge_names)
             if checkpoint_path and steps % (chunk * 16) == 0:
                 # deferred rows live only in host RAM (neither the device
                 # npz nor the host pickle covers them): materialize them
@@ -869,6 +994,7 @@ class _Frontier:
         occupancy = tel_words[n_op + n_lc + n_ec:n_op + n_lc + n_ec + 2]
         hwm = tel_words[n_op + n_lc + n_ec + 2:n_op + n_lc + n_ec + 4]
         tag_d = delta[n_op + n_lc + n_ec + 4:]
+        self._last_tag_delta = tag_d  # merge-pass trigger signal
 
         metrics.inc("frontier.telemetry.executed", int(np.sum(op_d)))
         metrics.inc("frontier.telemetry.forks",

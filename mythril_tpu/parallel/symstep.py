@@ -900,3 +900,225 @@ def sym_step_many_counted(state: StateBatch, planes: SymPlanes,
     state, planes, arena, sched = jax.lax.fori_loop(
         0, n_steps, body, (state, planes, arena, sched))
     return state, planes, arena, sched.executed
+
+
+# ---- on-device state merging (veritesting) --------------------------------------
+# Fork siblings that reconverged at a post-dominator pc are redundant: their
+# path conditions differ ONLY in the sign of the last condition appended at
+# the fork ((P & c) | (P & ~c) = P), and their machine states differ only in
+# the effects the two diamond arms produced. The merge pass pairs such lanes
+# and collapses each pair into ONE lane: drop the final condition, ITE-blend
+# every differing stack / storage slot through the arena's internal ite node
+# (op 0x0F — the host converts it to If(c, then, else), smt terms), retire
+# the partner DEAD so forks and reseeds reclaim it.
+#
+# Pairing is sort-based (the embarrassingly-parallel shape the ISSUE names):
+# every eligible lane gets a content hash over the leaves a merge must NOT
+# blend (pc, sp, memory, storage keys, conds prefix, ...), lanes sort by
+# (hash, last-cond sign), and adjacent (-, +) positions are verified exactly
+# before merging — a hash collision can only MISS a merge, never corrupt
+# one. Lanes allocate arena nodes independently, so only true fork siblings
+# (row copies sharing the conds prefix by id) pair up; cousin pairs merge
+# bottom-up across repeated rounds, collapsing a 2^k reconverged subtree in
+# k rounds.
+
+#: frontier.merge.ite_depth histogram buckets (blended slots per pair)
+MERGE_DEPTH_LABELS = ("0", "1", "2", "3", "4-7", "8+")
+N_MERGE_DEPTH = len(MERGE_DEPTH_LABELS)
+
+#: merge-pass stats vector layout: [merges, ites, tag_hits[K], depth_hist]
+MERGE_STATS_FIXED = 2
+
+_H_PRIME = 1099511628211
+_H_MASK = (1 << 62) - 1
+
+
+def _merge_fold(acc, leaf):
+    """Fold one per-lane leaf into the lane content hash (int64 wraparound
+    arithmetic; position-weighted so permuted content hashes apart)."""
+    flat = leaf.reshape(leaf.shape[0], -1).astype(jnp.int64)
+    mult = (jnp.arange(flat.shape[1], dtype=jnp.int64)
+            * jnp.int64(2654435761) + jnp.int64(0x9E3779B9)) | jnp.int64(1)
+    return acc * jnp.int64(_H_PRIME) + jnp.sum(flat * mult[None, :], axis=1)
+
+
+def _rows_equal(leaf, ti, fi):
+    """bool[P]: rows ti and fi of `leaf` are elementwise identical."""
+    a, b = leaf[ti], leaf[fi]
+    return jnp.all((a == b).reshape(a.shape[0], -1), axis=1)
+
+
+def merge_pass(state: StateBatch, planes: SymPlanes, arena: A.Arena,
+               merge_pcs: jnp.ndarray, n_rounds: int = 6
+               ) -> Tuple[StateBatch, SymPlanes, A.Arena, jnp.ndarray]:
+    """Collapse reconverged fork-sibling lanes; `n_rounds` greedy pairing
+    rounds per invocation (each round merges one level of the fork tree).
+    `merge_pcs` (i32[K] post-dominator merge points from staticanalysis/)
+    attributes merge events to tags for telemetry; pairing itself keys on
+    full state equality, which subsumes "reconverged at the join".
+    Returns (state, planes, arena, stats i64[2 + K + N_MERGE_DEPTH])."""
+    batch = state.pc.shape[0]
+    half = batch // 2
+    slots = planes.stack_sym.shape[1]
+    kslots = planes.storage_sym.shape[1]
+    max_conds = planes.conds.shape[1]
+    n_tags = merge_pcs.shape[0]
+    lane = jnp.arange(batch)
+
+    # leaves a merge must find identical (everything else is blended or
+    # recomputed). Immutable template planes — code, calldata, env words,
+    # gas_limit — are covered by ctx_id equality: lanes with one ctx_id
+    # were row-copied from one seed template and no device op writes them.
+    # Transient storage is required equal rather than blended (rare).
+    eq_leaves = (state.pc, state.sp, state.msize, state.code_len,
+                 state.retdata_len, state.retdata, state.memory,
+                 state.storage_keys, state.storage_used,
+                 state.tstore_keys, state.tstore_vals, state.tstore_used,
+                 planes.mem_sym, planes.storage_base_sym,
+                 planes.symbolic_env, planes.ctx_id)
+    static_h = jnp.zeros(batch, dtype=jnp.int64)
+    for leaf in eq_leaves:
+        static_h = _merge_fold(static_h, leaf)
+
+    stats0 = jnp.zeros(MERGE_STATS_FIXED + n_tags + N_MERGE_DEPTH,
+                       dtype=jnp.int64)
+
+    def one_round(r, carry):
+        state, planes, arena, stats = carry
+        cc = planes.cond_count
+        last_idx = jnp.clip(cc - 1, 0, max_conds - 1)
+        last = planes.conds[lane, last_idx]
+        sign = (last > 0).astype(jnp.int64)
+        # partners share |last| — hash with the sign stripped, sort on it
+        conds_abs = planes.conds.at[lane, last_idx].set(jnp.abs(last))
+        eligible = (state.status == RUNNING) & (cc > 0) & (last != 0) \
+            & (planes.fork_cond == 0)
+
+        h = _merge_fold(static_h, conds_abs)
+        h = h * jnp.int64(_H_PRIME) + cc.astype(jnp.int64)
+        key = jnp.where(eligible, ((h & jnp.int64(_H_MASK)) << 1) | sign,
+                        jnp.int64(0x7FFFFFFFFFFFFFFF))
+        perm = jnp.argsort(key)
+        # alternate pair alignment by round so an unpaired singleton can
+        # never shadow the same candidate pair across every round
+        perm = jnp.roll(perm, -(r % 2))
+        fi = perm[0:2 * half:2]   # sorts first in a group: last cond < 0
+        ti = perm[1:2 * half:2]   # last cond > 0 — the merge survivor
+
+        ok = eligible[ti] & eligible[fi]
+        last_t = last[ti]
+        ok &= (last_t > 0) & (last_t == -last[fi])
+        ok &= cc[ti] == cc[fi]
+        ok &= jnp.all(conds_abs[ti] == conds_abs[fi], axis=1)
+        for leaf in eq_leaves:
+            ok &= _rows_equal(leaf, ti, fi)
+
+        # ---- blend differing stack slots through ite(cond, then, else) ------
+        # cond is the survivor's positive last condition, so the taken
+        # side's value is the `then` child (op 0x0F: a != 0 -> b else c).
+        # Slots whose sym nodes agree need no blend — when nonzero the sym
+        # node governs materialization and the concrete word is dead.
+        sp_t = state.sp[ti]
+        sym_t, sym_f = planes.stack_sym[ti], planes.stack_sym[fi]
+        conc_t, conc_f = state.stack[ti], state.stack[fi]
+        live = jnp.arange(slots)[None, :] < sp_t[:, None]
+        sdiff = ok[:, None] & live & (
+            (sym_t != sym_f)
+            | ((sym_t == 0) & (sym_f == 0)
+               & jnp.any(conc_t != conc_f, axis=-1)))
+        limbs = state.stack.shape[-1]
+        arena, cid_t, ovf1 = A.alloc_consts(
+            arena, (sdiff & (sym_t == 0)).reshape(-1),
+            conc_t.reshape(half * slots, limbs))
+        arena, cid_f, ovf2 = A.alloc_consts(
+            arena, (sdiff & (sym_f == 0)).reshape(-1),
+            conc_f.reshape(half * slots, limbs))
+        node_t = jnp.where(sym_t.reshape(-1) != 0, sym_t.reshape(-1), cid_t)
+        node_f = jnp.where(sym_f.reshape(-1) != 0, sym_f.reshape(-1), cid_f)
+        cond_b = jnp.broadcast_to(last_t[:, None],
+                                  (half, slots)).reshape(-1)
+        zero = jnp.zeros_like(node_t)
+        arena, ite_s, ovf3 = A.alloc_rows(
+            arena, sdiff.reshape(-1), jnp.full_like(node_t, 0x0F),
+            cond_b, node_t, node_f, zero, zero)
+        stack_ovf = (ovf1 | ovf2 | ovf3).reshape(half, slots)
+
+        # ---- blend differing storage slots (keys/used verified equal) -------
+        ksym_t, ksym_f = planes.storage_sym[ti], planes.storage_sym[fi]
+        kval_t, kval_f = state.storage_vals[ti], state.storage_vals[fi]
+        kdiff = ok[:, None] & state.storage_used[ti] & (
+            (ksym_t != ksym_f)
+            | ((ksym_t == 0) & (ksym_f == 0)
+               & jnp.any(kval_t != kval_f, axis=-1)))
+        arena, kid_t, ovf4 = A.alloc_consts(
+            arena, (kdiff & (ksym_t == 0)).reshape(-1),
+            kval_t.reshape(half * kslots, limbs))
+        arena, kid_f, ovf5 = A.alloc_consts(
+            arena, (kdiff & (ksym_f == 0)).reshape(-1),
+            kval_f.reshape(half * kslots, limbs))
+        knode_t = jnp.where(ksym_t.reshape(-1) != 0, ksym_t.reshape(-1),
+                            kid_t)
+        knode_f = jnp.where(ksym_f.reshape(-1) != 0, ksym_f.reshape(-1),
+                            kid_f)
+        kcond_b = jnp.broadcast_to(last_t[:, None],
+                                   (half, kslots)).reshape(-1)
+        kzero = jnp.zeros_like(knode_t)
+        arena, ite_k, ovf6 = A.alloc_rows(
+            arena, kdiff.reshape(-1), jnp.full_like(knode_t, 0x0F),
+            kcond_b, knode_t, knode_f, kzero, kzero)
+        storage_ovf = (ovf4 | ovf5 | ovf6).reshape(half, kslots)
+
+        # arena exhaustion mid-blend: cancel the pair (both lanes keep
+        # exploring — a missed merge is a perf loss, never a lost path)
+        merged = ok & ~jnp.any(stack_ovf, axis=1) \
+            & ~jnp.any(storage_ovf, axis=1)
+
+        # ---- apply: rewrite the survivor, retire the partner ----------------
+        tset = jnp.where(merged, ti, batch).astype(I32)
+        fset = jnp.where(merged, fi, batch).astype(I32)
+        m2 = merged[:, None]
+        stack_sym = planes.stack_sym.at[tset].set(
+            jnp.where(sdiff & m2, ite_s.reshape(half, slots), sym_t),
+            mode="drop")
+        storage_sym = planes.storage_sym.at[tset].set(
+            jnp.where(kdiff & m2, ite_k.reshape(half, kslots), ksym_t),
+            mode="drop")
+        # either side's dirty writes must materialize from the survivor
+        storage_dirty = planes.storage_dirty.at[tset].set(
+            planes.storage_dirty[ti] | planes.storage_dirty[fi],
+            mode="drop")
+        conds = planes.conds.at[tset, last_idx[ti]].set(0, mode="drop")
+        cond_count = planes.cond_count.at[tset].set(cc[ti] - 1, mode="drop")
+        # deeper side wins: host depth bounds stay conservative
+        branches = planes.branches.at[tset].set(
+            jnp.maximum(planes.branches[ti], planes.branches[fi]),
+            mode="drop")
+        status = state.status.at[fset].set(I32(DEAD), mode="drop")
+        gas = state.gas_used.at[tset].set(
+            jnp.maximum(state.gas_used[ti], state.gas_used[fi]),
+            mode="drop")
+        state = state._replace(status=status, gas_used=gas)
+        planes = planes._replace(
+            stack_sym=stack_sym, storage_sym=storage_sym,
+            storage_dirty=storage_dirty, conds=conds,
+            cond_count=cond_count, branches=branches)
+
+        # ---- stats ----------------------------------------------------------
+        depth = jnp.sum(sdiff & m2, axis=1) + jnp.sum(kdiff & m2, axis=1)
+        stats = stats.at[0].add(jnp.sum(merged, dtype=jnp.int64))
+        stats = stats.at[1].add(jnp.sum(depth, dtype=jnp.int64))
+        if n_tags:
+            pc_t = state.pc[ti]
+            stats = stats.at[MERGE_STATS_FIXED:
+                             MERGE_STATS_FIXED + n_tags].add(jnp.sum(
+                                 merged[:, None]
+                                 & (pc_t[:, None] == merge_pcs[None, :]),
+                                 axis=0, dtype=jnp.int64))
+        bucket = jnp.where(depth >= 8, 5, jnp.where(depth >= 4, 4, depth))
+        stats = stats.at[jnp.where(
+            merged, MERGE_STATS_FIXED + n_tags + bucket,
+            stats.shape[0])].add(jnp.int64(1), mode="drop")
+        return state, planes, arena, stats
+
+    return jax.lax.fori_loop(0, n_rounds, one_round,
+                             (state, planes, arena, stats0))

@@ -378,22 +378,45 @@ def _bool_ite(cond: terms.Term, then: terms.Term,
 def _ite_ladder_eq(ladder: terms.Term,
                    const: terms.Term) -> Optional[terms.Term]:
     """(b): ``If(c0,a0,If(c1,a1,...)) == K`` — push the comparison into the
-    ladder when at least one leaf comparison folds constant. Built inside-out
-    so the result stays linear in the ladder length."""
-    entries: List[Tuple[terms.Term, terms.Term]] = []
-    node = ladder
-    while node.op == "ite":
-        entries.append((node.args[0], node.args[1]))
-        node = node.args[2]
-    leaf_eqs = [terms.bv_cmp("eq", value, const) for _, value in entries]
-    final_eq = terms.bv_cmp("eq", node, const)
-    if not any(_is_const(e) or e in (terms.TRUE, terms.FALSE)
-               for e in leaf_eqs + [final_eq]):
+    ladder when at least one leaf comparison folds constant.
+
+    Handles full ite TREES, not just right-leaning else-chains: the
+    device merge pass (parallel/symstep.py) blends reconverged lanes
+    bottom-up, so a twice-merged plane slot is
+    ``ite(c1, ite(c2a, v, w), ite(c2b, x, y))`` with ites in BOTH
+    branches. The walk is iterative post-order with memoization on the
+    hash-consed nodes — shared subtrees (cousin merges reuse leaf
+    values) are rewritten once, and branches whose pushed comparisons
+    come out identical collapse to that single result, so the output
+    stays linear in the number of DISTINCT nodes."""
+    memo: dict = {}
+    folded = False
+    pending = [ladder]
+    while pending:
+        node = pending[-1]
+        if id(node) in memo:
+            pending.pop()
+            continue
+        if node.op == "ite":
+            children = [child for child in node.args[1:]
+                        if id(child) not in memo]
+            if children:
+                pending.extend(children)
+                continue
+            pending.pop()
+            then_eq = memo[id(node.args[1])]
+            else_eq = memo[id(node.args[2])]
+            memo[id(node)] = then_eq if then_eq is else_eq \
+                else _bool_ite(node.args[0], then_eq, else_eq)
+        else:
+            pending.pop()
+            leaf_eq = terms.bv_cmp("eq", node, const)
+            if _is_const(leaf_eq) or leaf_eq in (terms.TRUE, terms.FALSE):
+                folded = True
+            memo[id(node)] = leaf_eq
+    if not folded:
         return None  # nothing folds: the rewrite would not shrink anything
-    result = final_eq
-    for (cond, _), leaf_eq in zip(reversed(entries), reversed(leaf_eqs)):
-        result = _bool_ite(cond, leaf_eq, result)
-    return result
+    return memo[id(ladder)]
 
 
 def _bounded_select_eq(selected: terms.Term, const: terms.Term,

@@ -55,6 +55,11 @@ class Args:
         #: device-resident frontier counter plane (parallel/symstep.py);
         #: --no-frontier-telemetry compiles it out for A/B measurement
         self.frontier_telemetry = True
+        #: on-device state merging at post-dominator join points
+        #: (parallel/symstep.py merge_pass); --no-state-merge turns it
+        #: off for A/B measurement. Distinct from enable_state_merging
+        #: below, which is the host post-transaction merge plugin.
+        self.state_merge = True
         self.sparse_pruning = True
         self.enable_state_merging = False
         self.enable_summaries = False

@@ -67,6 +67,14 @@ _KNOBS: List[Knob] = [
     Knob("MYTHRIL_TPU_JAX_CACHE", "str", None,
          "Persistent XLA compilation cache directory (dynamic default: "
          "~/.cache/mythril_tpu_jax)."),
+    Knob("MYTHRIL_TPU_STATE_MERGE", "flag", True,
+         "On-device state merging (veritesting): collapse sibling lanes "
+         "that reconverged after a fork into one lane with ITE-blended "
+         "planes; --no-state-merge / 0 disables for A/B measurement."),
+    Knob("MYTHRIL_TPU_MERGE_MIN_LANES", "int", 2,
+         "Merge-tag occupancy (lane-visits per chunk at one merge point) "
+         "that triggers a merge pass; with telemetry off the pass runs "
+         "on a fixed chunk cadence instead."),
     # -- batched SAT dispatch ----------------------------------------------------
     Knob("MYTHRIL_TPU_BATCH_FLUSH", "int", 16,
          "Queued SAT queries that trigger a batched device flush."),

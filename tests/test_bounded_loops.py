@@ -1,0 +1,120 @@
+"""Per-loop unroll budgets (core/strategy/bounded_loops.py):
+
+* one budget per natural loop — a loop with several back edges draws
+  every arrival at its header from ONE count, where the reference's
+  per-(source, target) counting granted each back edge its own bound;
+* device seeding — a state materialized from the frontier inside a
+  loop (LoopHintAnnotation) starts that loop's count at 1, because the
+  device already spent at least one unroll on it;
+* the fallback — JUMPDESTs the static loop table has no verdict for
+  keep the reference's per-edge counting.
+"""
+
+import sys
+from types import SimpleNamespace
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0] + "/..")
+
+from mythril_tpu.analysis import module_screen
+from mythril_tpu.core.strategy.bounded_loops import (BoundedLoopsStrategy,
+                                                     JumpdestCountAnnotation)
+
+HEADER = 10
+
+
+class FakeState:
+    """Just enough GlobalState surface for the strategy decorator."""
+
+    def __init__(self, address, prev_pc, annotations):
+        self._instruction = {"opcode": "JUMPDEST", "address": address}
+        self.annotations = annotations
+        self.mstate = SimpleNamespace(prev_pc=prev_pc)
+        self.environment = SimpleNamespace(code="FAKECODE")
+
+    def get_current_instruction(self):
+        return self._instruction
+
+    def get_annotations(self, cls):
+        return [a for a in self.annotations if isinstance(a, cls)]
+
+    def annotate(self, annotation):
+        self.annotations.append(annotation)
+
+
+class FakeSuper:
+    """Super-strategy stub replaying a scripted path: the shared
+    annotation list models annotation propagation along one path."""
+
+    def __init__(self, states):
+        self.states = list(states)
+        self.work_list = []
+        self.max_depth = 128
+
+    def __next__(self):
+        if not self.states:
+            raise StopIteration
+        return self.states.pop(0)
+
+
+def drain(strategy):
+    out = []
+    while True:
+        try:
+            out.append(next(strategy))
+        except StopIteration:
+            return out
+
+
+@pytest.fixture
+def loop_table(monkeypatch):
+    """Static loop table: every pc in [10, 40) belongs to the loop
+    headed at HEADER; everything else has no verdict."""
+    monkeypatch.setattr(
+        module_screen, "loop_header_at",
+        lambda code, pc: HEADER if HEADER <= pc < 40 else None)
+
+
+def test_multi_back_edge_loop_shares_one_budget(loop_table):
+    """Six arrivals at the header, alternating between two back edges:
+    per-edge counting would admit all six (3 + 3); the per-loop budget
+    admits exactly `loop_bound`."""
+    path = [JumpdestCountAnnotation()]
+    states = [FakeState(HEADER, prev_pc=20 if i % 2 else 30,
+                        annotations=path)
+              for i in range(6)]
+    strategy = BoundedLoopsStrategy(FakeSuper(states), loop_bound=3)
+    assert len(drain(strategy)) == 3
+
+
+def test_loop_hint_seeds_device_spent_unroll(loop_table):
+    """A state materialized mid-loop carries LoopHintAnnotation: the
+    first header arrival charges the seed too, leaving bound-1."""
+    from mythril_tpu.parallel.frontier import LoopHintAnnotation
+
+    path = [JumpdestCountAnnotation(), LoopHintAnnotation(HEADER)]
+    states = [FakeState(HEADER, prev_pc=20, annotations=path)
+              for _ in range(6)]
+    strategy = BoundedLoopsStrategy(FakeSuper(states), loop_bound=3)
+    assert len(drain(strategy)) == 2
+
+
+def test_edge_fallback_outside_recovered_loops(loop_table):
+    """pc 50 is outside the loop table: (source, target) counting —
+    two distinct sources each get their own bound, reference parity."""
+    path = [JumpdestCountAnnotation()]
+    states = [FakeState(50, prev_pc=60 if i % 2 else 70, annotations=path)
+              for i in range(6)]
+    strategy = BoundedLoopsStrategy(FakeSuper(states), loop_bound=2)
+    assert len(drain(strategy)) == 4
+
+
+def test_non_header_body_jumpdest_uses_edge_count(loop_table):
+    """A body JUMPDEST inside the loop (pc 20 != header) still counts
+    per edge — only header arrivals draw from the loop budget."""
+    path = [JumpdestCountAnnotation()]
+    states = [FakeState(20, prev_pc=15, annotations=path)
+              for _ in range(4)]
+    strategy = BoundedLoopsStrategy(FakeSuper(states), loop_bound=3)
+    assert len(drain(strategy)) == 3

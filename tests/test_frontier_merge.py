@@ -1,0 +1,256 @@
+"""On-device state merging at post-dominator join points
+(parallel/symstep.py merge_pass + the parallel/frontier.py cadence):
+
+* the synthetic diamond — two fork-sibling lanes reconverged at the
+  join collapse to ONE lane whose differing stack slot is an
+  ITE(cond, then, else) arena node over the two arm values, the final
+  path condition dropped ((P & c) | (P & ~c) = P);
+* the soundness gate — arms that diverged in memory must NOT merge
+  (mem_sym's byte encoding cannot represent a per-byte ITE);
+* the A/B contract — merged and unmerged runs of the same contract
+  produce byte-identical detections (fast branchy mini contract, plus
+  the full KILLBILLY creation+runtime flow as a slow test), with the
+  merged run actually reporting ``frontier.merge.*`` events.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("MYTHRIL_TPU_LANES", "16")
+
+jax = pytest.importorskip("jax")
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mythril_tpu.parallel import arena as parena
+from mythril_tpu.parallel import batch as pbatch
+from mythril_tpu.parallel import symstep
+from mythril_tpu.smt.solver import sat
+
+pytestmark = pytest.mark.skipif(not sat.have_native(),
+                                reason="native CDCL build required")
+
+#: the diamond: JUMPI on a symbolic calldata word forks at pc 5; the
+#: taken arm (JUMPDEST@11) pushes 5, the fall-through arm pushes 7,
+#: both reach the join JUMPDEST@15 after exactly three steps (the
+#: padding JUMPDEST@14 equalizes the arm lengths so the lockstep
+#: siblings arrive together) and then spin in the 3-step tail loop
+#: 15 -> 16 -> 18 -> 15, staying RUNNING and pc-aligned forever
+DIAMOND = bytes.fromhex(
+    "6000" "35"          # 0: PUSH1 0; CALLDATALOAD    (symbolic word)
+    "600b" "57"          # 3: PUSH1 11; JUMPI          (fork)
+    "6007" "600f" "56"   # 6: PUSH1 7; PUSH1 15; JUMP  (fall arm)
+    "5b" "6005"          # 11: JUMPDEST; PUSH1 5       (taken arm)
+    "5b"                 # 14: JUMPDEST                (padding)
+    "5b" "600f" "56")    # 15: JUMPDEST; PUSH1 15; JUMP (join + spin)
+
+#: same diamond, but the fall-through arm also writes memory
+#: (MSTORE8 0 <- 7) before the join — both arms push the SAME value 5
+#: so the concrete/symbolic stacks agree and only memory diverges
+DIAMOND_MEMWRITE = bytes.fromhex(
+    "6000" "35"               # 0: PUSH1 0; CALLDATALOAD
+    "6010" "57"               # 3: PUSH1 16; JUMPI
+    "6005"                    # 6: PUSH1 5            (fall arm, same value)
+    "6007" "6000" "53"        # 8: PUSH1 7; PUSH1 0; MSTORE8
+    "6017" "56"               # 13: PUSH1 23; JUMP
+    "5b" "6005"               # 16: JUMPDEST; PUSH1 5 (taken arm)
+    "5b" "5b" "5b" "5b"       # 19: JUMPDEST x4       (length padding)
+    "5b" "6017" "56")         # 23: JUMPDEST; PUSH1 23; JUMP (join + spin)
+
+STOP_ONLY = bytes.fromhex("00")
+
+
+def _diamond_run(code: bytes, n_steps: int):
+    """One diamond lane plus one STOP lane (dies immediately, so the
+    fork sibling claims it in-step and the two arms run in lockstep)."""
+    specs = [pbatch.LaneSpec(code, gas_limit=2 ** 40),
+             pbatch.LaneSpec(STOP_ONLY, gas_limit=2 ** 40)]
+    state = pbatch.build_batch(specs, stack_slots=16, memory_bytes=128,
+                               calldata_bytes=64, retdata_bytes=32,
+                               storage_slots=8, tstore_slots=2)
+    planes = symstep.SymPlanes.empty(2, 16, 128, 8, max_conds=8)
+    arena = parena.new_arena(capacity=1 << 10, const_capacity=1 << 6)
+    sched = symstep.new_scheduler(state, planes, 4, 4)
+    state, planes, arena, sched = symstep.run_chunk(
+        state, planes, arena, sched, n_steps)
+    return state, planes, arena
+
+
+def _const_word(arena, node: int) -> int:
+    """Decode a CONST arena node's 256-bit pool word to a Python int."""
+    op = int(np.asarray(arena.op)[node])
+    assert op == parena.CONST, f"node {node} is op {op:#x}, not CONST"
+    limbs = np.asarray(arena.const_vals)[int(np.asarray(arena.imm)[node])]
+    return sum(int(limb) << (16 * i) for i, limb in enumerate(limbs))
+
+
+def test_diamond_siblings_collapse_to_one_lane():
+    """After both arms reconverge at the join, one merge pass retires
+    the fall-through sibling and rewrites the survivor: path condition
+    popped, stack slot 0 ITE-blended from the two arm constants."""
+    # chunk length 10: fork at step 4, arms take 3 steps, and the tail
+    # loop (period 3) has both lanes sitting exactly ON the join pc 15
+    state, planes, arena, = _diamond_run(DIAMOND, n_steps=10)
+    st = np.asarray(state.status)
+    assert (st == symstep.RUNNING).sum() == 2  # both arms still live
+    np.testing.assert_array_equal(np.asarray(state.pc), [15, 15])
+    cond_node = int(np.asarray(planes.conds)[0, 0])
+    assert cond_node > 0 and int(np.asarray(planes.conds)[1, 0]) \
+        == -cond_node  # signed fork siblings
+
+    state, planes, arena, stats = symstep.merge_pass(
+        state, planes, arena, np.asarray([15], dtype=np.int32),
+        n_rounds=2)
+    stats = np.asarray(stats)
+
+    assert int(stats[0]) == 1  # exactly one pair merged
+    st = np.asarray(state.status)
+    assert (st == symstep.RUNNING).sum() == 1
+    assert (st == symstep.DEAD).sum() == 1
+    survivor = int(np.argmax(st == symstep.RUNNING))
+    # survivor carries the TAKEN side's positive condition... popped:
+    # (P & c) | (P & ~c) = P leaves an empty path condition
+    assert int(np.asarray(planes.cond_count)[survivor]) == 0
+    assert not np.asarray(planes.conds)[survivor].any()
+    # stack slot 0 is now ite(cond, 5, 7) through the arena
+    ite = int(np.asarray(planes.stack_sym)[survivor, 0])
+    assert ite > 0
+    assert int(np.asarray(arena.op)[ite]) == 0x0F
+    assert int(np.asarray(arena.a)[ite]) == cond_node
+    assert _const_word(arena, int(np.asarray(arena.b)[ite])) == 5
+    assert _const_word(arena, int(np.asarray(arena.c)[ite])) == 7
+    # stats attribution: the merge landed on the tagged join pc, with
+    # one blended slot (depth-histogram bucket "1")
+    fixed = symstep.MERGE_STATS_FIXED
+    assert int(stats[1]) == 1                    # one ITE blend
+    assert int(stats[fixed]) == 1                # tag_hits[merge@0xf]
+    depth_hist = stats[fixed + 1:]
+    assert int(depth_hist[symstep.MERGE_DEPTH_LABELS.index("1")]) == 1
+
+
+def test_diamond_memory_divergence_blocks_merge():
+    """The fall-through arm wrote memory before the join: the byte
+    planes cannot express a per-byte ITE, so the pair must NOT merge —
+    a missed merge is a perf loss, a wrong one a soundness hole."""
+    state, planes, arena = _diamond_run(DIAMOND_MEMWRITE, n_steps=10)
+    st = np.asarray(state.status)
+    assert (st == symstep.RUNNING).sum() == 2
+    assert np.asarray(state.pc)[0] == np.asarray(state.pc)[1]
+
+    state, planes, arena, stats = symstep.merge_pass(
+        state, planes, arena, np.asarray([23], dtype=np.int32),
+        n_rounds=2)
+
+    assert int(np.asarray(stats)[0]) == 0
+    st = np.asarray(state.status)
+    assert (st == symstep.RUNNING).sum() == 2  # both arms keep exploring
+
+
+#: a reconverging diamond ahead of an unprotected SELFDESTRUCT: both
+#: arms are 3 steps long (the pad JUMPDEST equalizes them) so the fork
+#: siblings arrive at the join in lockstep, then SSTORE the arm value
+#: — it stays live (stack, then storage) so whichever boundary the
+#: merge pass lands on has at least one differing slot to ITE-blend
+BRANCHY = {
+    "boom()":
+        "PUSH1 0x00\nCALLDATALOAD\nPUSH1 0x01\nAND\n"
+        "PUSH @odd\nJUMPI\n"
+        "PUSH1 0x07\nPUSH @join\nJUMP\n"
+        "odd:\nJUMPDEST\nPUSH1 0x05\nJUMPDEST\n"
+        "join:\nJUMPDEST\nPUSH1 0x00\nSSTORE\nJUMPDEST\n"
+        "CALLER\nSELFDESTRUCT",
+}
+
+
+def _analyze_branchy(merge_flag: bool, monkeypatch):
+    """One BRANCHY device-engine run with the state-merge flag forced
+    and a tiny chunk (so chunk boundaries — where the merge pass runs —
+    land while the reconverged siblings are still in lockstep)."""
+    from mythril_tpu.analysis.security import (fire_lasers,
+                                               reset_callback_modules)
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.frontends.asm import (assemble, creation_wrapper,
+                                           dispatcher)
+    from mythril_tpu.observe import metrics
+    from mythril_tpu.support.support_args import args as support_args
+
+    monkeypatch.setattr(support_args, "state_merge", merge_flag)
+    monkeypatch.setenv("MYTHRIL_TPU_CHUNK", "2")
+    metrics.reset("frontier.merge")
+    reset_callback_modules()
+    creation = creation_wrapper(assemble(dispatcher(BRANCHY)))
+    wrapper = SymExecWrapper(
+        creation.hex(), address=None, strategy="bfs", max_depth=128,
+        execution_timeout=240, create_timeout=30, transaction_count=1,
+        modules=["AccidentallyKillable"], compulsory_statespace=False,
+        engine="tpu")
+    issues = fire_lasers(wrapper, white_list=["AccidentallyKillable"])
+    detections = sorted(
+        (issue.swc_id, issue.address, issue.function,
+         [step.get("input") for step in
+          issue.transaction_sequence["steps"]])
+        for issue in issues)
+    return detections, metrics.snapshot()
+
+
+def test_merge_ab_detections_identical(monkeypatch):
+    """The veritesting contract: merging must be invisible to the
+    detectors — the same issues with the pass on and off — while the
+    merged run actually reports merge events (the frontier trigger,
+    the kernel, and the ITE materialization all fired). The witness
+    calldata is compared by selector: the merged path's constraint is
+    the (weaker) disjunction of the two arms, so the solver may pick a
+    different — still valid — concrete model for the unconstrained
+    branch word."""
+    merged, snap_on = _analyze_branchy(True, monkeypatch)
+    unmerged, snap_off = _analyze_branchy(False, monkeypatch)
+
+    def norm(detections):
+        return [(swc, addr, fn, [step[:10] for step in steps])
+                for swc, addr, fn, steps in detections]
+
+    assert norm(merged) == norm(unmerged)
+    assert [d[0] for d in merged] == ["106"]
+    assert snap_on.get("frontier.merge.events", 0) >= 1
+    assert snap_on.get("frontier.merge.lanes_retired", 0) >= 1
+    assert snap_on.get("frontier.merge.ites", 0) >= 1
+    assert snap_off.get("frontier.merge.events", 0) == 0
+
+
+@pytest.mark.slow
+def test_merge_ab_killbilly_parity(monkeypatch):
+    """Full creation+runtime multi-transaction flow (KILLBILLY) stays
+    byte-identical in detections with the merge pass on and off."""
+    from test_analysis import KILLBILLY
+
+    from mythril_tpu.analysis.security import (fire_lasers,
+                                               reset_callback_modules)
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.frontends.asm import (assemble, creation_wrapper,
+                                           dispatcher)
+    from mythril_tpu.support.support_args import args as support_args
+
+    def run(merge_flag: bool):
+        monkeypatch.setattr(support_args, "state_merge", merge_flag)
+        reset_callback_modules()
+        creation = creation_wrapper(assemble(dispatcher(KILLBILLY)))
+        wrapper = SymExecWrapper(
+            creation.hex(), address=None, strategy="bfs", max_depth=128,
+            execution_timeout=240, create_timeout=30, transaction_count=2,
+            modules=["AccidentallyKillable"], compulsory_statespace=False,
+            engine="tpu")
+        issues = fire_lasers(wrapper, white_list=["AccidentallyKillable"])
+        return sorted(
+            (issue.swc_id, issue.address, issue.function,
+             [step.get("input") for step in
+              issue.transaction_sequence["steps"]])
+            for issue in issues)
+
+    merged = run(True)
+    unmerged = run(False)
+    assert merged == unmerged
+    assert [d[0] for d in merged] == ["106"]

@@ -136,6 +136,49 @@ def test_ite_ladder_no_rewrite_without_fold():
     assert outcome.constraints == [conjunct]
 
 
+def test_ite_tree_collapse_blended_planes():
+    """The device merge pass blends reconverged lanes bottom-up, so a
+    k-times-merged plane slot is a BALANCED ite tree (ites in both
+    branches) with constant arm values at the leaves. Compared against
+    a constant, the whole 256-bit mux tree must collapse to pure
+    boolean structure — no bitvector ite survives to the blaster."""
+    conds = [terms.bv_cmp("eq", terms.bv_var(f"c{i}", 64),
+                          terms.bv_const(0, 64)) for i in range(7)]
+    # depth-3 balanced tree over 8 constant leaves (two leaves equal K)
+    leaves = [terms.bv_const(value, 8)
+              for value in (2, 7, 11, 2, 13, 17, 19, 23)]
+    level = leaves
+    cond_iter = iter(conds)
+    while len(level) > 1:
+        level = [terms.ite(next(cond_iter), level[i], level[i + 1])
+                 for i in range(0, len(level), 2)]
+    tree = level[0]
+    conjuncts = [terms.bv_cmp("eq", tree, terms.bv_const(2, 8))]
+    outcome = assert_equivalent(conjuncts)
+    assert SolverStatistics().simplify_ite_collapses >= 1
+    for conjunct in outcome.constraints:
+        # surviving ites are boolean selectors only — every 8-bit mux
+        # (and its 256-bit analogue on real planes) is gone
+        assert all(node.op != "ite" or node.sort is terms.BOOL
+                   for node in terms.walk(conjunct))
+
+
+def test_ite_tree_shared_subtrees_rewritten_once():
+    """Cousin merges reuse leaf values: a tree whose branches SHARE a
+    hash-consed subtree must still collapse (memoized walk), and
+    branches whose pushed comparisons agree fold to that one result."""
+    c1 = terms.bv_cmp("eq", terms.bv_var("c1", 64), terms.bv_const(0, 64))
+    c2 = terms.bv_cmp("eq", terms.bv_var("c2", 64), terms.bv_const(0, 64))
+    shared = terms.ite(c2, terms.bv_const(5, 8), terms.bv_const(9, 8))
+    tree = terms.ite(c1, shared, shared)
+    conjuncts = [terms.bv_cmp("eq", tree, terms.bv_const(5, 8))]
+    outcome = assert_equivalent(conjuncts)
+    assert SolverStatistics().simplify_ite_collapses >= 1
+    for conjunct in outcome.constraints:
+        # ite(c1, t, t) = t: the duplicate branch vanished entirely
+        assert all(node.op != "ite" for node in terms.walk(conjunct))
+
+
 # -- (c) keccak injectivity --------------------------------------------------------
 
 

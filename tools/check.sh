@@ -30,6 +30,11 @@ timeout -k 10 60 python -m tools.frontierview \
     tests/data/trace/frontier_trace.json > /dev/null || exit $?
 
 echo
+echo "== merge smoke (state-merge A/B: >=1 merge event + parity) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu \
+    python -m tools.merge_smoke || exit $?
+
+echo
 echo "== serve smoke (daemon start -> request -> clean shutdown) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu \
     python -m tools.serve_smoke || exit $?

@@ -17,12 +17,17 @@ and samples them via ``observe/trace.py``'s counter API) and prints:
   fork waits, cold-SLOAD pauses (``frontier.lifecycle``);
 * **per-loop / per-merge-tag occupancy** (``frontier.tags``): how many
   lane-steps ran at each ``loop@pc`` / ``merge@pc`` site the static
-  analysis annotated.
+  analysis annotated;
+* **state-merge events** (``frontier.merges``): reconverged
+  fork-sibling pairs the veritesting pass collapsed, and the ITE
+  blends it allocated doing so.
 
 With ``--metrics`` it also summarizes an fsync-atomic metrics snapshot
 (``analyze --metrics-out`` / ``MYTHRIL_TPU_METRICS`` /
 ``observe.metrics.write_snapshot``): the ``frontier.telemetry.*``
-counters, gauges, and labeled histograms.
+counters, gauges, and labeled histograms, plus the
+``frontier.merge.*`` slice — merges per join-point tag, lanes
+retired, and the ITE-depth (blended-slots-per-pair) histogram.
 
 Stdlib-only (json/argparse): usable on a workstation without jax.
 Exit codes: 0 on success (even when the trace has no counter tracks —
@@ -46,6 +51,7 @@ OPS_TRACK = "frontier.ops"
 CAUSES_TRACK = "frontier.causes"
 LIFECYCLE_TRACK = "frontier.lifecycle"
 TAGS_TRACK = "frontier.tags"
+MERGES_TRACK = "frontier.merges"
 
 
 def load_trace(path: str) -> Tuple[List[dict], Dict[str, object]]:
@@ -181,6 +187,23 @@ def _tags_section(totals: Dict[str, float]) -> List[str]:
     return lines
 
 
+def _merges_section(totals: Dict[str, float]) -> List[str]:
+    lines = ["", "== state-merge events (veritesting) =="]
+    if not totals:
+        lines.append("  (no frontier.merges samples — state merging off "
+                     "(--no-state-merge / MYTHRIL_TPU_STATE_MERGE=0) or "
+                     "no lanes reconverged)")
+        return lines
+    merged = totals.get("merged", 0)
+    ites = totals.get("ites", 0)
+    lines.append(f"  {'pairs merged':<16} {merged:>12.0f}  "
+                 "(one lane retired each)")
+    lines.append(f"  {'ITE blends':<16} {ites:>12.0f}  "
+                 f"({ites / merged:.1f} per pair)" if merged else
+                 f"  {'ITE blends':<16} {ites:>12.0f}")
+    return lines
+
+
 def report(events: List[dict], other: Dict[str, object]) -> str:
     lines: List[str] = ["== frontier telemetry =="]
     for key in ("engine", "contracts", "started_at"):
@@ -192,6 +215,7 @@ def report(events: List[dict], other: Dict[str, object]) -> str:
     causes = sum_series(counter_samples(events, CAUSES_TRACK))
     lifecycle = sum_series(counter_samples(events, LIFECYCLE_TRACK))
     tags = sum_series(counter_samples(events, TAGS_TRACK))
+    merges = sum_series(counter_samples(events, MERGES_TRACK))
     n_counter = sum(1 for e in events if e.get("ph") == "C")
     lines.append(f"  counter samples: {n_counter} "
                  f"({len(lanes)} chunk(s) with lane telemetry)")
@@ -205,21 +229,22 @@ def report(events: List[dict], other: Dict[str, object]) -> str:
     lines.extend(_ranked_table(causes, "escape/prune causes", "lanes"))
     lines.extend(_lifecycle_section(lifecycle))
     lines.extend(_tags_section(tags))
+    lines.extend(_merges_section(merges))
     return "\n".join(lines)
 
 
-def metrics_report(snapshot: Dict[str, object]) -> str:
-    """Summarize the frontier.telemetry.* slice of a metrics snapshot
-    (observe.metrics.write_snapshot / --metrics-out)."""
-    lines = ["", "== metrics snapshot (frontier.telemetry.*) =="]
+def _metrics_slice(snapshot: Dict[str, object], prefix: str,
+                   empty_note: str) -> List[str]:
+    """Render every `prefix`-named entry of a metrics snapshot."""
+    lines = [f"== metrics snapshot ({prefix}*) =="]
     rows = {name: value for name, value in snapshot.items()
-            if str(name).startswith("frontier.telemetry.")}
+            if str(name).startswith(prefix)}
     if not rows:
-        lines.append("  (snapshot has no frontier.telemetry entries)")
-        return "\n".join(lines)
+        lines.append(f"  ({empty_note})")
+        return lines
     for name in sorted(rows):
         value = rows[name]
-        short = name[len("frontier.telemetry."):]
+        short = name[len(prefix):]
         if isinstance(value, dict) and value and all(
                 isinstance(v, dict) for v in value.values()):
             # labeled histogram: {label: {count, sum, ...}}
@@ -234,6 +259,22 @@ def metrics_report(snapshot: Dict[str, object]) -> str:
             lines.append(f"  {short:<24} {detail}")
         else:
             lines.append(f"  {short:<24} {value}")
+    return lines
+
+
+def metrics_report(snapshot: Dict[str, object]) -> str:
+    """Summarize the frontier.telemetry.* and frontier.merge.* slices of
+    a metrics snapshot (observe.metrics.write_snapshot /
+    --metrics-out)."""
+    lines = [""]
+    lines.extend(_metrics_slice(
+        snapshot, "frontier.telemetry.",
+        "snapshot has no frontier.telemetry entries"))
+    lines.append("")
+    lines.extend(_metrics_slice(
+        snapshot, "frontier.merge.",
+        "no merge passes ran — state merging off or no reconverged "
+        "lanes"))
     return "\n".join(lines)
 
 

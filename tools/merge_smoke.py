@@ -1,0 +1,103 @@
+"""State-merging smoke for the pre-merge gate (tools/check.sh).
+
+One tiny reconverging-diamond contract through the device engine,
+twice:
+
+1. merge ON — require at least one ``frontier.merge.events`` (the
+   post-dominator trigger, the merge kernel, and the ITE
+   materialization all fired);
+2. merge OFF (``support_args.state_merge = False``, the
+   ``--no-state-merge`` path) — require zero merge events;
+3. the two runs must report the same detections (selector-normalized
+   witnesses: the merged path constraint is the weaker disjunction, so
+   the solver may pick a different — still valid — model for the
+   unconstrained branch word).
+
+Prints ``MERGE_SMOKE=ok`` on success; any failure exits non-zero with a
+diagnostic.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# tiny chunks put the merge boundary inside the lockstep window where
+# both reconverged siblings sit on the join pc
+os.environ["MYTHRIL_TPU_CHUNK"] = "2"
+os.environ.setdefault("MYTHRIL_TPU_LANES", "16")
+
+#: a reconverging diamond ahead of an unprotected SELFDESTRUCT — both
+#: arms are the same length, so the fork siblings arrive at the join in
+#: lockstep, and the SSTOREd arm value gives the pass a slot to blend
+BRANCHY = {
+    "boom()":
+        "PUSH1 0x00\nCALLDATALOAD\nPUSH1 0x01\nAND\n"
+        "PUSH @odd\nJUMPI\n"
+        "PUSH1 0x07\nPUSH @join\nJUMP\n"
+        "odd:\nJUMPDEST\nPUSH1 0x05\nJUMPDEST\n"
+        "join:\nJUMPDEST\nPUSH1 0x00\nSSTORE\nJUMPDEST\n"
+        "CALLER\nSELFDESTRUCT",
+}
+
+
+def _analyze(merge_flag: bool):
+    from mythril_tpu.analysis.security import (fire_lasers,
+                                               reset_callback_modules)
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.frontends.asm import (assemble, creation_wrapper,
+                                           dispatcher)
+    from mythril_tpu.observe import metrics
+    from mythril_tpu.support.support_args import args as support_args
+
+    support_args.state_merge = merge_flag
+    metrics.reset("frontier.merge")
+    reset_callback_modules()
+    creation = creation_wrapper(assemble(dispatcher(BRANCHY)))
+    wrapper = SymExecWrapper(
+        creation.hex(), address=None, strategy="bfs", max_depth=128,
+        execution_timeout=240, create_timeout=30, transaction_count=1,
+        modules=["AccidentallyKillable"], compulsory_statespace=False,
+        engine="tpu")
+    issues = fire_lasers(wrapper, white_list=["AccidentallyKillable"])
+    detections = sorted(
+        (issue.swc_id, issue.address, issue.function,
+         [step.get("input", "")[:10] for step in
+          issue.transaction_sequence["steps"]])
+        for issue in issues)
+    return detections, metrics.snapshot()
+
+
+def main() -> int:
+    merged, snap_on = _analyze(True)
+    unmerged, snap_off = _analyze(False)
+
+    events = snap_on.get("frontier.merge.events", 0)
+    retired = snap_on.get("frontier.merge.lanes_retired", 0)
+    if events < 1 or retired < 1:
+        print(f"merge_smoke: merged run reported no merge events "
+              f"(events={events}, lanes_retired={retired})",
+              file=sys.stderr)
+        return 1
+    if snap_off.get("frontier.merge.events", 0) != 0:
+        print("merge_smoke: merge-off run still reported merge events",
+              file=sys.stderr)
+        return 1
+    if merged != unmerged:
+        print(f"merge_smoke: detection mismatch\n  on:  {merged}\n"
+              f"  off: {unmerged}", file=sys.stderr)
+        return 1
+    if [d[0] for d in merged] != ["106"]:
+        print(f"merge_smoke: expected one SWC-106 issue, got {merged}",
+              file=sys.stderr)
+        return 1
+    print(f"merge_smoke: {events} merge event(s), {retired} lane(s) "
+          f"retired, detections identical with merging off")
+    print("MERGE_SMOKE=ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
