@@ -130,6 +130,16 @@ def _frontier_rollup():
     return rollup
 
 
+def _solver_latency():
+    """Batched-flush latency quantiles for the BENCH json — the number
+    tools/benchview.py renders as the solver-latency trend. Zeros when
+    no batched flush ran (host-only or tiny runs)."""
+    from mythril_tpu.observe import metrics
+
+    return {key: round(metrics.quantile("dispatch.flush.latency_ms", q), 3)
+            for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"))}
+
+
 def main():
     seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 45.0
     import jax
@@ -249,6 +259,7 @@ def main():
             "host": host_info,
             "merge_ab": merge_ab,
             "frontier": _frontier_rollup(),
+        "solver_latency_ms": _solver_latency(),
             "corpus": _corpus_extras(),
             "trace": trace_path,
             "metrics": metrics_path,
@@ -279,6 +290,7 @@ def main():
         "sym_host": host_info,
         "merge_ab": merge_ab,
         "frontier": _frontier_rollup(),
+        "solver_latency_ms": _solver_latency(),
         "corpus": _corpus_extras(),
         "trace": trace_path,
         "metrics": metrics_path,

@@ -24,8 +24,19 @@ accounting from an exported trace. See README "Observability".
 
 Both modules are stdlib-only: the lint framework and the traceview CLI
 load them without importing jax or the rest of the package.
+
+ISSUE 12 adds the read-side fleet surface on top:
+
+* :mod:`~mythril_tpu.observe.export` — Prometheus text exposition of
+  the metric registry (``# HELP``/``# TYPE`` from the declared specs,
+  histogram quantiles as summary series), a bounded in-process snapshot
+  ring, and scrape-time device-memory accounting (HBM live/peak via
+  jax ``memory_stats`` — host-side only, never inside the jitted step).
+* :mod:`~mythril_tpu.observe.slog` — structured JSON logging with a
+  per-request correlation id minted at serve admission and carried by a
+  ``ContextVar`` through frontier/dispatch records and analyze replies.
 """
 
-from . import metrics, trace  # noqa: F401
+from . import export, metrics, slog, trace  # noqa: F401
 
-__all__ = ["metrics", "trace"]
+__all__ = ["export", "metrics", "slog", "trace"]

@@ -25,6 +25,7 @@ the rest of the package.
 
 from __future__ import annotations
 
+import math
 import threading
 from collections import deque
 from typing import Dict, List, NamedTuple, Optional
@@ -143,6 +144,15 @@ _METRICS: List[MetricSpec] = [
                "DFS sibling-stack depth high-water, this device phase."),
     MetricSpec("frontier.telemetry.esc_hwm", GAUGE, "rows",
                "Escape-buffer occupancy high-water, this device phase."),
+    MetricSpec("frontier.telemetry.stack_bytes", GAUGE, "bytes",
+               "DFS sibling-stack HBM bytes at the high-water mark "
+               "(stack_hwm x packed row bytes), this device phase."),
+    MetricSpec("frontier.telemetry.esc_bytes", GAUGE, "bytes",
+               "Escape-buffer HBM bytes at the high-water mark "
+               "(esc_hwm x packed row bytes), this device phase."),
+    MetricSpec("frontier.telemetry.arena_bytes", GAUGE, "bytes",
+               "Constraint-arena HBM bytes live on device (allocated "
+               "nodes x per-node bytes), this device phase."),
     MetricSpec("frontier.telemetry.op_class", HISTOGRAM, "1",
                "Per-chunk executed instructions by opcode class "
                "(label = class, symstep.OP_CLASS_NAMES)."),
@@ -221,9 +231,18 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("taint.frontier.loop_tagged", COUNTER, "1",
                "Materialized device lanes tagged with the natural-loop "
                "header their pc sits inside (bounded-unroll budgeting)."),
+    # -- device memory accounting (observe/export.py, sampled at scrape) ---------
+    MetricSpec("device.hbm.bytes_in_use", GAUGE, "bytes",
+               "Live HBM bytes across visible devices (jax "
+               "memory_stats), sampled host-side at scrape/snapshot "
+               "time — never inside the jitted step."),
+    MetricSpec("device.hbm.peak_bytes", GAUGE, "bytes",
+               "Peak HBM bytes across visible devices since process "
+               "start (jax memory_stats peak_bytes_in_use)."),
     # -- analysis service (mythril_tpu/serve/) -----------------------------------
     MetricSpec("serve.requests", COUNTER, "1",
-               "Requests the analysis service finished (ok or error)."),
+               "Requests the analysis service answered (ok, error, or "
+               "busy bounce)."),
     MetricSpec("serve.request_errors", COUNTER, "1",
                "Requests answered with an error reply (malformed input, "
                "failed analysis, unknown op)."),
@@ -239,6 +258,9 @@ _METRICS: List[MetricSpec] = [
                "rebuilt."),
     MetricSpec("serve.request_ms", HISTOGRAM, "ms",
                "Wall time of one analysis request, warmup excluded."),
+    MetricSpec("serve.metrics_scrapes", COUNTER, "1",
+               "Metrics scrapes answered (GET /metrics or the `metrics` "
+               "protocol op); never takes the engine lock."),
     # -- engine plugins (core/plugin/plugins/) -----------------------------------
     MetricSpec("profiler.instruction_us", HISTOGRAM, "us",
                "Per-opcode host-engine instruction latency "
@@ -265,6 +287,10 @@ def _spec(name: str, *kinds: str) -> MetricSpec:
     return spec
 
 
+#: quantiles surfaced by as_dict()/snapshot()/the Prometheus exporter
+QUANTILES = ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"))
+
+
 class _Hist:
     """Histogram state: aggregates + bounded reservoir."""
 
@@ -286,12 +312,42 @@ class _Hist:
             self.max = value
         self.recent.append(value)
 
+    @property
+    def dropped(self) -> int:
+        """Observations that fell out of the bounded reservoir: count
+        minus what ``recent`` still holds. Non-zero means quantiles are
+        biased toward the *most recent* RESERVOIR observations — the
+        aggregates (count/sum/min/max) stay exact."""
+        return self.count - len(self.recent)
+
+    def quantile(self, q: float) -> float:
+        """Nearest-rank quantile over the bounded reservoir (0 when
+        nothing was observed). ``q`` in [0, 1]; q=0 is the reservoir
+        min, q=1 the reservoir max. When ``dropped`` is non-zero this
+        is a recency-biased estimate, not the lifetime quantile."""
+        if not self.recent:
+            return 0.0
+        ordered = sorted(self.recent)
+        if q <= 0.0:
+            return ordered[0]
+        if q >= 1.0:
+            return ordered[-1]
+        rank = int(math.ceil(q * len(ordered))) - 1
+        return ordered[max(0, min(rank, len(ordered) - 1))]
+
     def as_dict(self) -> dict:
         if not self.count:
             return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
-                    "avg": 0.0}
-        return {"count": self.count, "sum": self.total, "min": self.min,
-                "max": self.max, "avg": self.total / self.count}
+                    "avg": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+        out = {"count": self.count, "sum": self.total, "min": self.min,
+               "max": self.max, "avg": self.total / self.count}
+        for q, key in QUANTILES:
+            out[key] = self.quantile(q)
+        if self.dropped:
+            # drop accounting: snapshots must say when the quantiles
+            # cover a recency-biased window, not the whole run
+            out["reservoir_dropped"] = self.dropped
+        return out
 
 
 class _Store:
@@ -358,6 +414,16 @@ def labels(name: str) -> List[str]:
     """Labels observed on a declared histogram."""
     _spec(name, HISTOGRAM)
     return sorted(_STORE.hists.get(name, {}))
+
+
+def quantile(name: str, q: float, label: str = "") -> float:
+    """Nearest-rank quantile of a declared histogram's reservoir (0.0
+    when nothing was observed) — the read path the Prometheus exporter,
+    bench extras, and traceview's serve rollup share."""
+    hist = histogram(name, label)
+    if hist is None:
+        return 0.0
+    return hist.quantile(q)
 
 
 def snapshot() -> dict:

@@ -47,7 +47,7 @@ from ..core.state.annotation import StateAnnotation
 from ..core.state.global_state import GlobalState
 from ..exceptions import UnsatError
 from ..smt.solver import cfa_screen
-from ..observe import metrics, trace
+from ..observe import metrics, slog, trace
 from ..smt import Bool, Extract, symbol_factory
 from ..smt import terms as T
 from ..support import tpu_config
@@ -499,6 +499,10 @@ class _Frontier:
         esc_rows = int(max(2 * self.n_lanes,
                            min(1 << 16, 8 * self.n_lanes,
                                self.esc_bytes // max(row_bytes, 1))))
+        # the telemetry decode converts pool high-water marks into HBM
+        # byte gauges with this factor — pure host arithmetic on numbers
+        # the summary download already carries
+        self._row_bytes = row_bytes
         log.info("device scheduler: %d stack + %d escape rows x %d B "
                  "(%.0f MiB HBM)", stack_rows, esc_rows, row_bytes,
                  (stack_rows + esc_rows) * row_bytes / 2 ** 20)
@@ -1010,6 +1014,23 @@ class _Frontier:
                     lc["cold_sloads"])
         metrics.set_gauge("frontier.telemetry.stack_hwm", int(hwm[0]))
         metrics.set_gauge("frontier.telemetry.esc_hwm", int(hwm[1]))
+        # device-memory accounting: high-water rows x packed row bytes,
+        # arena nodes x per-node bytes — shape/dtype metadata only, no
+        # extra device syncs beyond the summary download we already have
+        row_bytes = getattr(self, "_row_bytes", 0)
+        node_bytes = getattr(self, "_arena_node_bytes", None)
+        if node_bytes is None:
+            node_bytes = sum(
+                int(np.dtype(leaf.dtype).itemsize) for leaf in self.arena
+                if getattr(leaf, "ndim", 0) == 1
+                and leaf.shape[0] == self.arena.capacity)
+            self._arena_node_bytes = node_bytes
+        stack_bytes = int(hwm[0]) * row_bytes
+        esc_bytes = int(hwm[1]) * row_bytes
+        arena_bytes = arena_n * node_bytes
+        metrics.set_gauge("frontier.telemetry.stack_bytes", stack_bytes)
+        metrics.set_gauge("frontier.telemetry.esc_bytes", esc_bytes)
+        metrics.set_gauge("frontier.telemetry.arena_bytes", arena_bytes)
         if int(occupancy[1]):
             metrics.set_gauge("frontier.telemetry.occupancy",
                               float(occupancy[0]) / float(occupancy[1]))
@@ -1029,10 +1050,21 @@ class _Frontier:
             if count:
                 metrics.observe("frontier.telemetry.tag_occupancy",
                                 int(count), label=name)
+        if slog.enabled():
+            # correlated structured log line per chunk: under serve the
+            # handling thread's contextvar carries the request's cid
+            slog.event("frontier.chunk", running=running,
+                       stack=stack_top, escaped=esc_count,
+                       arena=arena_n,
+                       executed=int(np.sum(op_d)),
+                       stack_bytes=stack_bytes, esc_bytes=esc_bytes,
+                       arena_bytes=arena_bytes)
         if trace.enabled():
             trace.counter("frontier.lanes", running=running,
                           stack=stack_top, escaped=esc_count)
             trace.counter("frontier.arena", nodes=arena_n)
+            trace.counter("frontier.memory", stack_bytes=stack_bytes,
+                          esc_bytes=esc_bytes, arena_bytes=arena_bytes)
             trace.counter("frontier.ops", **{
                 name: int(count)
                 for name, count in zip(symstep.OP_CLASS_NAMES, op_d)})

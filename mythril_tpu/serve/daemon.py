@@ -19,6 +19,7 @@ import threading
 from typing import Optional
 
 from . import protocol
+from ..observe import slog
 from ..support import tpu_config
 
 log = logging.getLogger(__name__)
@@ -56,10 +57,12 @@ def serve_stdio(service, stdin=None, stdout=None) -> int:
     rfile = stdin if stdin is not None else sys.stdin.buffer
     wfile = stdout if stdout is not None else sys.stdout.buffer
     service.startup()
+    slog.event("serve.listening", transport="stdio")
     try:
         return serve_stream(service, rfile, wfile)
     finally:
         service.shutdown()
+        slog.event("serve.stopped", transport="stdio")
 
 
 def _connection_worker(service, connection) -> None:
@@ -106,6 +109,8 @@ def serve_socket(service, socket_path: Optional[str] = None,
             ready_event.set()
         log.info("serving on %s (max_inflight=%d)", path,
                  service.max_inflight)
+        slog.event("serve.listening", transport="socket", path=path,
+                   max_inflight=service.max_inflight)
         workers = []
         while not service.shutting_down.is_set():
             try:
@@ -127,6 +132,8 @@ def serve_socket(service, socket_path: Optional[str] = None,
     finally:
         service.shutdown()
         server.close()
+        slog.event("serve.stopped", transport="socket",
+                   connections=accepted)
         try:
             os.unlink(path)
         except OSError:

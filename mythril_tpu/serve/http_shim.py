@@ -5,7 +5,9 @@ reply as the JSON response body — the same validation, admission, and
 isolation as the socket path, because every request still goes through
 ``AnalysisService.handle``. ``GET /healthz`` answers a metrics
 summary (uptime, request counters, warm buckets, frontier telemetry
-rollup) without touching the engine. This is deliberately a shim, not a web framework:
+rollup) and ``GET /metrics`` answers Prometheus text exposition
+(observe/export.py) — both without touching the engine, so a scrape
+during a long analyze never blocks. This is deliberately a shim, not a web framework:
 stdlib ``http.server`` only, one process, no TLS — put a real proxy in
 front if this ever leaves localhost.
 """
@@ -39,14 +41,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_text(self, status: int, body: str,
+                    content_type: str) -> None:
+        payload = body.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
     def do_GET(self):
-        if self.path != "/healthz":
-            self._reply(404, protocol.error_reply(
-                None, "bad_request", "GET supports /healthz only"))
+        if self.path == "/healthz":
+            reply = self.service.handle(
+                protocol.Request("healthz", "healthz", {}))
+            self._reply(200, reply)
             return
-        reply = self.service.handle(
-            protocol.Request("healthz", "healthz", {}))
-        self._reply(200, reply)
+        if self.path == "/metrics":
+            # Prometheus scrape: text exposition, not a JSON envelope
+            reply = self.service.handle(
+                protocol.Request("metrics", "metrics", {}))
+            self._reply_text(200, reply["exposition"],
+                             reply["content_type"])
+            return
+        self._reply(404, protocol.error_reply(
+            None, "bad_request", "GET supports /healthz and /metrics"))
 
     def do_POST(self):
         try:

@@ -13,7 +13,9 @@ Request shape::
      "strategy": "bfs"}
 
 Ops: ``analyze`` (the workload), ``ping`` (liveness), ``status`` (warm-set
-and metrics introspection), ``shutdown`` (drain and exit). Replies echo
+and metrics introspection), ``healthz`` (liveness + counters rollup),
+``metrics`` (Prometheus exposition + the snapshot-ring tail; never
+touches the engine lock), ``shutdown`` (drain and exit). Replies echo
 the request ``id`` (auto-assigned ``req-N`` when absent) and carry either
 ``"ok": true`` plus the payload, or ``"ok": false`` plus a typed error::
 
@@ -41,7 +43,7 @@ from typing import Dict, Iterator, List, Optional
 #: of hex); 8 MiB leaves room for huge inits while bounding a hostile peer
 MAX_LINE_BYTES = 8 << 20
 
-OPS = ("analyze", "ping", "status", "shutdown", "healthz")
+OPS = ("analyze", "ping", "status", "shutdown", "healthz", "metrics")
 
 STRATEGIES = ("dfs", "bfs", "naive-random", "weighted-random",
               "beam-search", "pending")

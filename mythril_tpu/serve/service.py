@@ -37,7 +37,7 @@ from typing import Dict, Optional
 
 from . import protocol
 from .warmset import WarmSet
-from ..observe import metrics, trace
+from ..observe import export, metrics, slog, trace
 from ..support import tpu_config
 
 log = logging.getLogger(__name__)
@@ -122,21 +122,35 @@ class AnalysisService:
                                      uptime_s=round(self.uptime_s(), 3))
         if request.op == "healthz":
             return self._healthz(request)
+        if request.op == "metrics":
+            return self._metrics(request)
         if request.op == "status":
             return self._status(request)
         if request.op == "shutdown":
             self.shutting_down.set()
             return protocol.ok_reply(request.id, shutdown=True,
                                      requests_served=self._requests_done)
-        # analyze: bounded admission, serialized execution
+        # analyze: bounded admission, serialized execution. The
+        # correlation id is minted here, at admission — a busy bounce
+        # gets one too, so its log line and reply still correlate.
+        cid = slog.new_correlation_id()
         if not self._gate.acquire(blocking=False):
-            metrics.inc("serve.busy_rejections")
-            return protocol.error_reply(
+            with slog.correlated(cid):
+                metrics.inc("serve.requests")
+                metrics.inc("serve.busy_rejections")
+                slog.event("serve.busy", request_id=str(request.id),
+                           max_inflight=self.max_inflight)
+            reply = protocol.error_reply(
                 request.id, "busy",
                 f"{self.max_inflight} requests already in flight")
+            reply["correlation_id"] = cid
+            return reply
         try:
-            with self._engine_lock:
-                return self._analyze(request)
+            with slog.correlated(cid):
+                slog.event("serve.admitted", request_id=str(request.id),
+                           op=request.op)
+                with self._engine_lock:
+                    return self._analyze(request, cid)
         finally:
             self._gate.release()
 
@@ -157,6 +171,21 @@ class AnalysisService:
                   "warmset": self.warmset.status()},
             frontier=_frontier_counters())
 
+    def _metrics(self, request) -> Dict:
+        """Scrape (the `metrics` op / GET /metrics): the full registry
+        as Prometheus text exposition plus the snapshot-ring tail.
+        Handled *before* admission — a scrape during a long analyze
+        (engine lock held) must answer immediately, never block."""
+        metrics.inc("serve.metrics_scrapes")
+        export.collect_device_memory()
+        ring = export.ring()
+        ring.record(scrape=str(request.id))
+        return protocol.ok_reply(
+            request.id,
+            exposition=export.render_prometheus(),
+            content_type=export.CONTENT_TYPE,
+            ring={"capacity": ring.capacity, "entries": ring.tail(8)})
+
     def _status(self, request) -> Dict:
         from ..smt.solver import dispatch
 
@@ -170,14 +199,14 @@ class AnalysisService:
             cached_verdicts=dispatch.cached_verdicts(),
             metrics=metrics.snapshot())
 
-    def _analyze(self, request) -> Dict:
+    def _analyze(self, request, cid: str) -> Dict:
         params = request.params
         started = time.monotonic()
         cold_before = metrics.value("xla.bucket_compiles")
         warm_before = metrics.value("xla.bucket_reuses")
         frontier_before = _frontier_counters()
-        with trace.span("serve.request",
-                        request_id=str(request.id)) as span:
+        with trace.span("serve.request", request_id=str(request.id),
+                        correlation_id=cid) as span:
             try:
                 payload = self._run_analysis(params)
             except (KeyboardInterrupt, SystemExit):
@@ -187,9 +216,13 @@ class AnalysisService:
                 metrics.inc("serve.requests")
                 metrics.inc("serve.request_errors")
                 span.set(error=repr(error))
-                return protocol.error_reply(
+                slog.event("serve.reply", request_id=str(request.id),
+                           ok=False, error=repr(error))
+                reply = protocol.error_reply(
                     request.id, "analysis_failed",
                     f"{type(error).__name__}: {error}")
+                reply["correlation_id"] = cid
+                return reply
             cold = metrics.value("xla.bucket_compiles") - cold_before
             warm = metrics.value("xla.bucket_reuses") - warm_before
             frontier = {name: value - frontier_before[name]
@@ -203,8 +236,17 @@ class AnalysisService:
         metrics.observe("serve.request_ms", elapsed_ms)
         self._requests_done += 1
         self.warmset.record_observed()
+        # one snapshot-ring tick per finished request: the "periodic"
+        # cadence of a daemon is its request stream
+        export.record_snapshot(request_id=str(request.id),
+                               correlation_id=cid)
+        slog.event("serve.reply", request_id=str(request.id), ok=True,
+                   issues=payload["issue_count"],
+                   elapsed_ms=round(elapsed_ms, 3),
+                   cold_buckets=cold, warm_hits=warm)
         return protocol.ok_reply(
             request.id,
+            correlation_id=cid,
             elapsed_ms=round(elapsed_ms, 3),
             warm={"cold_buckets": cold, "warm_hits": warm},
             frontier=frontier,

@@ -56,7 +56,7 @@ log = logging.getLogger(__name__)
 
 from . import sat
 from .solver_statistics import SolverStatistics
-from ...observe import metrics, trace
+from ...observe import metrics, slog, trace
 from ...support import tpu_config
 
 Verdict = Tuple[int, Optional[List[bool]]]
@@ -305,6 +305,11 @@ class DispatchQueue:
         if batched:
             statistics.batch_device_time += elapsed
             metrics.observe("dispatch.flush.latency_ms", elapsed * 1000.0)
+        if slog.enabled():
+            # correlated flush record: cid rides the serve contextvar
+            slog.event("dispatch.flush", occupancy=len(entries),
+                       batched=batched,
+                       latency_ms=round(elapsed * 1000.0, 3))
         # wall budget per AMORTIZED query, not per batch: N queries sharing
         # one launch legitimately take up to N x the per-query budget
         # (ISSUE 3 satellite: the old code charged the whole batch's elapsed

@@ -139,6 +139,19 @@ _KNOBS: List[Knob] = [
          "Write an fsync-atomic JSON metrics snapshot to this path when "
          "the analysis finishes; `analyze --metrics-out` sets the same "
          "path."),
+    Knob("MYTHRIL_TPU_SLOG", "str", None,
+         "Structured JSON log sink (observe/slog.py): a file path, or "
+         "'stderr'; setting it enables correlated one-object-per-line "
+         "log records carrying each request's correlation id."),
+    Knob("MYTHRIL_TPU_METRICS_RING", "int", 256,
+         "Snapshot entries kept by the in-process metrics time-series "
+         "ring (observe/export.py); the `metrics` protocol op and GET "
+         "/metrics serve its tail."),
+    Knob("MYTHRIL_TPU_BENCH_TOLERANCE", "float", 0.2,
+         "Relative regression tolerance for the tools/benchview.py perf "
+         "sentinel: a tracked headline number that worsens by more than "
+         "this fraction between consecutive comparable runs fails the "
+         "gate."),
     # -- static control-flow analysis (mythril_tpu/staticanalysis/) ---------------
     Knob("MYTHRIL_TPU_CFA", "flag", True,
          "Build static CFA tables (CFG, post-dominator merge points, "

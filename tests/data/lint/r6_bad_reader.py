@@ -1,0 +1,13 @@
+"""R6 bad fixture: reader-side calls (exporter / view surface) with
+literal names that are not declared in the registry must fire, same as
+emitters — a typo'd scrape silently renders a zero forever."""
+
+from mythril_tpu.observe import metrics
+from mythril_tpu.observe.metrics import quantile
+
+
+def scrape():
+    total = metrics.value("serve.requsts")  # typo: serve.requests
+    p95 = quantile("dispatch.flush.latentcy_ms", 0.95)  # typo: latency_ms
+    hist = metrics.histogram("frontier.telemetry.op_clas")  # typo: op_class
+    return total, p95, hist

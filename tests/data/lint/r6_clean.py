@@ -16,3 +16,12 @@ def emit(watcher: Watcher, name: str):
     metrics.observe("dispatch.flush.occupancy", 16)
     watcher.observe("anything.goes")  # not the metrics module
     metrics.set_value(name, 0)  # dynamic-name facade path: runtime contract
+
+
+def read(name: str):
+    total = metrics.value("serve.requests")
+    p95 = metrics.quantile("dispatch.flush.latency_ms", 0.95)
+    hist = metrics.histogram("frontier.telemetry.op_class", label="ADD")
+    per_label = metrics.labels("frontier.telemetry.op_class")
+    dynamic = metrics.value(name)  # dynamic-name read: runtime contract
+    return total, p95, hist, per_label, dynamic

@@ -1,0 +1,157 @@
+"""Unit tests for tools/benchview.py — the perf-regression sentinel over
+the committed BENCH_r*.json lineage (comparability-key grouping,
+consecutive-drop detection, skip accounting, the CLI gate, and the
+self-check fixture proof).
+"""
+
+import json
+import os
+
+import pytest
+
+from tools import benchview
+
+
+def _round(tmp_path, index, parsed, rc=0):
+    path = os.path.join(str(tmp_path), f"BENCH_r{index:02d}.json")
+    with open(path, "w", encoding="utf-8") as handle:
+        json.dump({"n": index, "cmd": "test", "rc": rc, "tail": "",
+                   "parsed": parsed}, handle)
+    return path
+
+
+def _headline(value, backend="tpu", n_branches=20, n_lanes=4096):
+    return {"metric": "sym_states_per_sec", "value": value,
+            "unit": "states/s", "backend": backend,
+            "n_branches": n_branches, "n_lanes": n_lanes}
+
+
+# -- extraction + comparability keys -------------------------------------------------
+
+
+def test_extract_points_headline_merge_and_corpus():
+    parsed = dict(_headline(100.0),
+                  merge_ab={"chunk": 4, "wall_speedup": 6.9,
+                            "states_ratio": 48.4},
+                  corpus={"host": {"budget_s": 90,
+                                   "median_states_per_sec": 24.8,
+                                   "total_swc_findings": 27},
+                          "tpu": {"budget_s": 90,
+                                  "median_states_per_sec": 4.7,
+                                  "total_swc_findings": 24}})
+    points = benchview.extract_points("r07", {"parsed": parsed})
+    by_series = {point.series: point for point in points}
+    assert by_series["sym_states_per_sec"].value == 100.0
+    assert by_series["sym_states_per_sec"].key == \
+        ("sym_states_per_sec", "tpu", 20, 4096)
+    assert by_series["merge_ab.wall_speedup"].key == \
+        ("merge_ab.wall_speedup", "tpu", 4)
+    assert by_series["corpus.host.median_states_per_sec"].key == \
+        ("corpus.host.median_states_per_sec", 90)
+    assert by_series["corpus.tpu.total_swc_findings"].value == 24.0
+    assert len(points) == 7
+
+
+def test_extract_points_skips_unparsed_rounds():
+    assert benchview.extract_points("r01", {"parsed": None}) == []
+    assert benchview.extract_points("r01", {"rc": 124}) == []
+
+
+def test_different_configs_never_compare():
+    """A 4096-lane TPU run and a 128-lane CPU run land in different
+    series: heterogeneous lineage history cannot trip the gate."""
+    points = (benchview.extract_points(
+                  "r01", {"parsed": _headline(50000.0)})
+              + benchview.extract_points(
+                  "r02", {"parsed": _headline(400.0, backend="cpu",
+                                              n_branches=10,
+                                              n_lanes=128)}))
+    series = benchview.build_series(points)
+    assert len(series) == 2
+    assert benchview.find_regressions(series, tolerance=0.2) == []
+
+
+# -- regression detection ------------------------------------------------------------
+
+
+def test_consecutive_drop_beyond_tolerance_fires():
+    points = [benchview.extract_points(f"r{i:02d}",
+                                       {"parsed": _headline(value)})[0]
+              for i, value in enumerate((100.0, 105.0, 60.0), start=1)]
+    series = benchview.build_series(points)
+    regressions = benchview.find_regressions(series, tolerance=0.2)
+    assert len(regressions) == 1
+    reg = regressions[0]
+    assert (reg.prev_label, reg.label) == ("r02", "r03")
+    assert reg.drop == pytest.approx((105.0 - 60.0) / 105.0)
+    # a drop inside tolerance stays green
+    assert benchview.find_regressions(series, tolerance=0.5) == []
+
+
+def test_zero_baseline_is_skipped():
+    points = [benchview.extract_points(f"r{i:02d}",
+                                       {"parsed": _headline(value)})[0]
+              for i, value in enumerate((0.0, 10.0), start=1)]
+    series = benchview.build_series(points)
+    assert benchview.find_regressions(series, tolerance=0.2) == []
+
+
+# -- lineage loading + report --------------------------------------------------------
+
+
+def test_check_lineage_reports_trend_and_skips(tmp_path):
+    paths = [
+        _round(tmp_path, 1, None, rc=124),
+        _round(tmp_path, 2, _headline(100.0)),
+        _round(tmp_path, 3, _headline(110.0)),
+    ]
+    report, code = benchview.check_lineage(paths, tolerance=0.2)
+    assert code == 0
+    assert "r02=100" in report and "r03=110 (+10%)" in report
+    assert "r01: no parsed payload (rc=124)" in report
+    assert "no regressions beyond tolerance" in report
+
+
+def test_check_lineage_flags_regression(tmp_path):
+    paths = [_round(tmp_path, 1, _headline(100.0)),
+             _round(tmp_path, 2, _headline(50.0))]
+    report, code = benchview.check_lineage(paths, tolerance=0.2)
+    assert code == 1
+    assert "<-- REGRESSION" in report
+    assert "REGRESSIONS:" in report and "-50%" in report
+
+
+def test_check_lineage_empty_is_exit_2():
+    report, code = benchview.check_lineage([], tolerance=0.2)
+    assert code == 2 and "no BENCH" in report
+
+
+def test_main_gates_and_renders_metrics(tmp_path, capsys):
+    paths = [_round(tmp_path, 1, _headline(100.0)),
+             _round(tmp_path, 2, _headline(90.0))]
+    metrics_path = os.path.join(str(tmp_path), "bench_metrics.json")
+    with open(metrics_path, "w", encoding="utf-8") as handle:
+        json.dump({"dispatch.flush.latency_ms":
+                   {"count": 3, "p50": 1.5, "p95": 4.0, "p99": 4.0},
+                   "xla.bucket_compiles": 2,
+                   "xla.bucket_reuses": 7}, handle)
+    code = benchview.main(paths + ["--tolerance", "0.2",
+                                   "--metrics", metrics_path])
+    out = capsys.readouterr().out
+    assert code == 0
+    assert "p50=1.5ms" in out and "p95=4ms" in out
+    assert "2 cold buckets, 7 warm hits" in out
+    assert benchview.main(paths + ["--tolerance", "0.05"]) == 1
+    capsys.readouterr()
+
+
+def test_self_check_passes():
+    assert benchview.self_check(tolerance=0.2) == 0
+
+
+def test_repo_lineage_stays_green(capsys):
+    """The committed BENCH_r*.json history must pass the sentinel at the
+    default tolerance — check.sh runs exactly this."""
+    code = benchview.main([])
+    capsys.readouterr()
+    assert code == 0

@@ -435,7 +435,8 @@ def _corpus_bytecodes():
     vendored headline contracts when the reference tree is absent."""
     out = []
     names = sorted(json.load(
-        open(os.path.join(REPO_ROOT, "corpus_host.json")))["contracts"])
+        open(os.path.join(REPO_ROOT, "tests", "data", "corpus",
+                          "corpus_host.json")))["contracts"])
     for name in names:
         path = os.path.join(REFERENCE_CORPUS, name)
         if os.path.exists(path):

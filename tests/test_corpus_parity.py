@@ -1,12 +1,14 @@
-"""Findings-parity lock over the committed corpus measurements
+"""Findings-parity lock over the vendored corpus measurements
 (VERDICT r4 next-round #2 done-criterion: per-contract corpus_tpu SWC sets
 must be a superset of corpus_host at equal budget).
 
-tools/measure_corpus.py writes corpus_{engine}.json from real equal-budget
-sweeps (the tpu sweep on the chip, the host sweep on CPU); this test locks
-the committed results so a findings regression cannot land silently. The
-sweeps themselves are too slow for CI (19 contracts x 2 engines x 90 s) —
-re-run the tool after engine changes and commit the refreshed jsons.
+tools/measure_corpus.py writes corpus_{engine}.json at the repo root from
+real equal-budget sweeps (the tpu sweep on the chip, the host sweep on
+CPU); the blessed snapshots are vendored under tests/data/corpus/ so this
+test locks them while the repo-root outputs stay untracked run artifacts.
+The sweeps themselves are too slow for CI (19 contracts x 2 engines x
+90 s) — re-run the tool after engine changes and refresh the vendored
+jsons.
 """
 
 import json
@@ -14,11 +16,12 @@ import os
 
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "data", "corpus")
 
 
 def _load(engine):
-    path = os.path.join(REPO, f"corpus_{engine}.json")
+    path = os.path.join(FIXTURES, f"corpus_{engine}.json")
     if not os.path.exists(path):
         pytest.skip(f"{path} not measured")
     with open(path) as handle:

@@ -1,0 +1,203 @@
+"""Unit tests for the observe read-side surface ISSUE 12 added:
+Prometheus text exposition (observe/export.py), the bounded snapshot
+ring, device-memory accounting, and structured logging with correlation
+ids (observe/slog.py).
+
+The exposition tests double as the acceptance proof for the scrape
+contract: every rendered series resolves to a declared metric and every
+``# HELP`` line carries that metric's registry doc verbatim.
+"""
+
+import json
+import re
+
+import pytest
+
+from mythril_tpu.observe import export, metrics, slog
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability(monkeypatch):
+    monkeypatch.delenv("MYTHRIL_TPU_SLOG", raising=False)
+    monkeypatch.delenv("MYTHRIL_TPU_METRICS_RING", raising=False)
+    metrics.reset()
+    slog.reset()
+    export.reset_ring()
+    yield
+    metrics.reset()
+    slog.reset()
+    export.reset_ring()
+
+
+# -- Prometheus exposition -----------------------------------------------------------
+
+#: suffixes the renderer may append to a metric's Prometheus name
+_SUFFIXES = ("_total", "_sum", "_count", "_reservoir_dropped")
+
+
+def _base_name(series_line: str) -> str:
+    """``mythril_tpu_x_total{a="b"} 3`` -> the declared-metric part."""
+    name = re.split(r"[{ ]", series_line, maxsplit=1)[0]
+    for suffix in _SUFFIXES:
+        if name.endswith(suffix):
+            candidate = name[: -len(suffix)]
+            if candidate in _DECLARED_PROM:
+                return candidate
+    return name
+
+
+_DECLARED_PROM = {export.prometheus_name(name) for name in metrics.REGISTRY}
+
+
+def test_every_exposition_line_names_a_declared_metric():
+    metrics.inc("serve.requests", 3)
+    metrics.set_gauge("frontier.telemetry.occupancy", 0.5)
+    metrics.observe("dispatch.flush.latency_ms", 12.5)
+    metrics.observe("profiler.instruction_us", 7.0, label="ADD")
+    text = export.render_prometheus()
+    assert text.endswith("\n")
+    docs = {export.prometheus_name(spec.name): spec.doc
+            for spec in metrics._METRICS}
+    for line in text.splitlines():
+        assert line, "exposition must not contain blank lines"
+        if line.startswith("# HELP "):
+            name, doc = line[len("# HELP "):].split(" ", 1)
+            assert name in _DECLARED_PROM, f"HELP for undeclared {name}"
+            assert doc == docs[name].replace("\n", "\\n"), \
+                f"HELP drifted from the registry doc for {name}"
+        elif line.startswith("# TYPE "):
+            name, kind = line[len("# TYPE "):].split(" ", 1)
+            assert name in _DECLARED_PROM
+            assert kind in ("counter", "gauge", "summary")
+        else:
+            assert _base_name(line) in _DECLARED_PROM, \
+                f"series line for undeclared metric: {line!r}"
+    # the whole declared surface renders, even never-touched metrics
+    for prom in _DECLARED_PROM:
+        assert f"# HELP {prom} " in text
+
+
+def test_counter_and_gauge_rendering():
+    metrics.inc("serve.requests", 3)
+    metrics.set_gauge("frontier.telemetry.arena_bytes", 4096)
+    text = export.render_prometheus()
+    assert "\nmythril_tpu_serve_requests_total 3\n" in text
+    assert "\nmythril_tpu_frontier_telemetry_arena_bytes 4096\n" in text
+    # untouched scalars still render as 0
+    assert "\nmythril_tpu_serve_busy_rejections_total 0\n" in text
+
+
+def test_histogram_renders_as_summary_with_quantiles_and_labels():
+    for value in (10.0, 20.0, 30.0, 40.0):
+        metrics.observe("dispatch.flush.latency_ms", value)
+    metrics.observe("profiler.instruction_us", 7.0, label="ADD")
+    text = export.render_prometheus()
+    prom = "mythril_tpu_dispatch_flush_latency_ms"
+    assert f'{prom}{{quantile="0.5"}} 20.0' in text
+    assert f'{prom}{{quantile="0.95"}} 40.0' in text
+    assert f"{prom}_sum 100.0" in text
+    assert f"{prom}_count 4" in text
+    # the per-label breakdown rides a label="..." dimension
+    assert ('mythril_tpu_profiler_instruction_us'
+            '{label="ADD",quantile="0.5"} 7.0') in text
+    # unobserved histograms render zero sum/count, no quantile series
+    assert "mythril_tpu_serve_request_ms_sum 0.0" in text
+    assert "mythril_tpu_serve_request_ms_count 0" in text
+    assert "mythril_tpu_serve_request_ms{" not in text
+
+
+def test_help_lines_escape_newlines_and_backslashes():
+    assert export._escape_help("a\nb\\c") == "a\\nb\\\\c"
+    assert export._escape_label('say "hi"\n') == 'say \\"hi\\"\\n'
+
+
+def test_collect_device_memory_never_raises():
+    stats = export.collect_device_memory()
+    assert isinstance(stats, dict)
+    if stats:  # an accelerator with memory_stats() was visible
+        assert stats["devices"] >= 1
+        assert metrics.value("device.hbm.bytes_in_use") == \
+            stats["bytes_in_use"]
+
+
+# -- snapshot ring -------------------------------------------------------------------
+
+
+def test_ring_is_bounded_and_sequenced(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_METRICS_RING", "4")
+    export.reset_ring()
+    ring = export.ring()
+    assert ring.capacity == 4
+    for i in range(10):
+        metrics.inc("serve.requests")
+        ring.record(request_id=f"r{i}")
+    assert len(ring) == 4
+    entries = ring.tail()
+    assert [entry["request_id"] for entry in entries] == \
+        ["r6", "r7", "r8", "r9"]
+    seqs = [entry["seq"] for entry in entries]
+    assert seqs == sorted(seqs) and seqs[-1] == 10
+    assert entries[-1]["metrics"]["serve.requests"] == 10
+    assert ring.tail(2) == entries[-2:]
+
+
+def test_record_snapshot_uses_the_process_ring():
+    entry = export.record_snapshot(scrape="s1")
+    assert entry["scrape"] == "s1" and "metrics" in entry
+    assert export.ring().tail()[-1]["seq"] == entry["seq"]
+
+
+# -- structured logging --------------------------------------------------------------
+
+
+def test_slog_disabled_is_a_noop(tmp_path):
+    sink = tmp_path / "never.slog"
+    assert not slog.enabled()
+    slog.event("frontier.chunk", running=8)  # must not raise or write
+    assert not sink.exists()
+
+
+def test_slog_writes_json_lines_with_correlation_scope(tmp_path):
+    sink = str(tmp_path / "run.slog")
+    slog.enable(sink)
+    assert slog.enabled() and slog.sink_path() == sink
+    slog.event("serve.listening", transport="stdio")
+    cid = slog.new_correlation_id()
+    with slog.correlated(cid) as scoped:
+        assert scoped == cid and slog.correlation_id() == cid
+        slog.event("frontier.chunk", running=8, stack=3)
+    assert slog.correlation_id() is None  # scope restored
+    records = [json.loads(line)
+               for line in open(sink, encoding="utf-8")]
+    assert [record["event"] for record in records] == \
+        ["serve.listening", "frontier.chunk"]
+    assert records[0]["cid"] is None
+    assert records[1]["cid"] == cid
+    assert records[1]["running"] == 8 and records[1]["stack"] == 3
+    assert all("ts" in record for record in records)
+
+
+def test_slog_env_knob_enables_at_first_use(tmp_path, monkeypatch):
+    sink = str(tmp_path / "env.slog")
+    monkeypatch.setenv("MYTHRIL_TPU_SLOG", sink)
+    slog.reset()  # back to never-touched: env re-read at next use
+    slog.event("dispatch.flush", occupancy=4)
+    assert slog.enabled()
+    record = json.loads(open(sink, encoding="utf-8").read())
+    assert record["event"] == "dispatch.flush"
+    assert record["occupancy"] == 4
+
+
+def test_correlation_ids_are_unique_and_shaped():
+    first = slog.new_correlation_id()
+    second = slog.new_correlation_id()
+    assert first != second
+    assert re.fullmatch(r"c[0-9a-f]+-[0-9a-f]{6}-\d+", first)
+
+
+def test_slog_survives_a_dead_sink(tmp_path):
+    sink = str(tmp_path / "dead.slog")
+    slog.enable(sink)
+    slog._SLOGGER._handle.close()  # simulate the sink dying under us
+    slog.event("serve.reply", ok=True)  # must not raise
+    assert not slog.enabled()  # logger turned itself off

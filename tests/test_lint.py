@@ -166,6 +166,9 @@ def _r8(name):
     (_r6, "r6_bad_undeclared.py",
      {"solver.warp_speed", "frontier.vibes", "dispatch.flux_capacitance"}),
     (_r6, "r6_bad_from_import.py", {"solver.queries_typo"}),
+    (_r6, "r6_bad_reader.py",
+     {"serve.requsts", "dispatch.flush.latentcy_ms",
+      "frontier.telemetry.op_clas"}),
     (_r6, "r6_bad_counter_track.py",
      {"frontier.telemetry.excuted", "frontier.telemetry.occupancy_pct",
       "frontier.telemtry.lifecycle"}),

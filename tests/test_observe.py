@@ -233,8 +233,65 @@ def test_histogram_labels_and_aggregates():
     assert metrics.labels("profiler.instruction_us") == ["ADD", "SSTORE"]
     hist = metrics.histogram("profiler.instruction_us", "ADD")
     assert hist.as_dict() == {"count": 2, "sum": 40.0, "min": 10.0,
-                              "max": 30.0, "avg": 20.0}
+                              "max": 30.0, "avg": 20.0,
+                              "p50": 10.0, "p95": 30.0, "p99": 30.0}
     assert metrics.histogram("profiler.instruction_us", "MUL") is None
+
+
+def test_quantile_nearest_rank_and_edges():
+    for value in (10.0, 20.0, 30.0, 40.0):
+        metrics.observe("dispatch.flush.latency_ms", value)
+    hist = metrics.histogram("dispatch.flush.latency_ms")
+    assert hist.quantile(0.5) == 20.0   # ceil(0.5*4)=2 -> 2nd smallest
+    assert hist.quantile(0.75) == 30.0
+    assert hist.quantile(0.95) == 40.0
+    assert hist.quantile(0.0) == 10.0   # q<=0 -> reservoir min
+    assert hist.quantile(1.0) == 40.0   # q>=1 -> reservoir max
+    assert metrics.quantile("dispatch.flush.latency_ms", 0.5) == 20.0
+    # never-observed histograms read 0.0 — the exporter renders them
+    # without blowing up on a fresh process
+    assert metrics.quantile("serve.request_ms", 0.99) == 0.0
+
+
+def test_reservoir_overflow_biases_quantiles_but_accounts_drops():
+    """Past RESERVOIR observations the quantiles cover only the most
+    recent window; `dropped` says exactly how many fell out, and the
+    exact aggregates (count/sum/min/max) are unaffected."""
+    extra = 1000
+    total = metrics.RESERVOIR + extra
+    for i in range(total):
+        metrics.observe("serve.request_ms", float(i))
+    hist = metrics.histogram("serve.request_ms")
+    assert hist.count == total
+    assert hist.dropped == extra
+    assert hist.min == 0.0 and hist.max == float(total - 1)
+    # the oldest `extra` observations are gone: the reservoir floor is
+    # the first value that survived, not the lifetime minimum
+    assert hist.quantile(0.0) == float(extra)
+    assert hist.quantile(1.0) == float(total - 1)
+    stats = hist.as_dict()
+    assert stats["reservoir_dropped"] == extra
+    assert stats["count"] == total and stats["min"] == 0.0
+    assert stats["p50"] >= float(extra)
+    # under-capacity histograms must NOT carry the drop marker
+    metrics.observe("dispatch.flush.latency_ms", 1.0)
+    small = metrics.histogram("dispatch.flush.latency_ms").as_dict()
+    assert "reservoir_dropped" not in small
+
+
+def test_snapshot_quantiles_roundtrip_frontierview(tmp_path):
+    """snapshot() -> write_snapshot -> frontierview --metrics keeps the
+    quantile keys end to end: the offline view renders the p95 computed
+    by the live reservoir."""
+    from tools import frontierview
+
+    for value in (1.0, 2.0, 30.0):
+        metrics.observe("frontier.telemetry.op_class", value, label="ADD")
+    path = metrics.write_snapshot(str(tmp_path / "metrics.json"))
+    snapshot = json.load(open(path))
+    assert snapshot["frontier.telemetry.op_class"]["ADD"]["p95"] == 30.0
+    report = frontierview.metrics_report(snapshot)
+    assert "p95 30.0" in report
 
 
 def test_snapshot_shape_and_prefix_reset():

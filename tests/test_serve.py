@@ -14,7 +14,7 @@ import threading
 import numpy as np
 import pytest
 
-from mythril_tpu.observe import metrics, trace
+from mythril_tpu.observe import export, metrics, slog, trace
 from mythril_tpu.parallel import jax_solver
 from mythril_tpu.serve import client as serve_client
 from mythril_tpu.serve import daemon, protocol, warmset
@@ -25,9 +25,13 @@ from mythril_tpu.serve.service import AnalysisService
 def _clean_observability():
     metrics.reset()
     trace.reset()
+    slog.reset()
+    export.reset_ring()
     yield
     metrics.reset()
     trace.reset()
+    slog.reset()
+    export.reset_ring()
 
 
 def _fake_batch_runner(chunk, forced_depth):
@@ -315,6 +319,147 @@ def test_second_request_hits_warm_buckets(monkeypatch):
     assert metrics.value("serve.requests") == 2
     hist = metrics.histogram("serve.request_ms")
     assert hist is not None and hist.count == 2
+
+
+# -- observability: scrape ops, correlation ids, concurrency ------------------------
+
+
+def _fake_payload(params):
+    return {"issue_count": 0, "incomplete": False, "coverage": {},
+            "report": {"issues": []}}
+
+
+def test_metrics_op_returns_exposition_and_ring_tail(monkeypatch):
+    service = _service()
+    monkeypatch.setattr(service, "_run_analysis", _fake_payload)
+    analyze = service.handle(protocol.parse_request(
+        '{"op": "analyze", "id": "a", "code": "6001"}'))
+    assert analyze["ok"]
+    reply = service.handle(protocol.parse_request(
+        '{"op": "metrics", "id": "m"}'))
+    assert reply["ok"]
+    assert reply["content_type"].startswith("text/plain; version=0.0.4")
+    assert "mythril_tpu_serve_requests_total 1" in reply["exposition"]
+    assert metrics.value("serve.metrics_scrapes") == 1
+    # ring carries one entry per finished analyze + one per scrape
+    entries = reply["ring"]["entries"]
+    assert [e.get("request_id") or e.get("scrape") for e in entries] == \
+        ["a", "m"]
+    assert entries[0]["correlation_id"] == analyze["correlation_id"]
+    assert entries[0]["metrics"]["serve.requests"] == 1
+
+
+def test_scrapes_answer_while_engine_lock_is_held(monkeypatch):
+    """A /healthz or /metrics probe during a long analyze must answer
+    immediately: both ops are routed before admission and never take
+    the engine lock."""
+    service = _service()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def slow_analysis(params):
+        entered.set()
+        assert release.wait(30)
+        return _fake_payload(params)
+
+    monkeypatch.setattr(service, "_run_analysis", slow_analysis)
+    worker = threading.Thread(
+        target=service.handle,
+        args=(protocol.parse_request(
+            '{"op": "analyze", "id": "slow", "code": "6001"}'),),
+        daemon=True)
+    worker.start()
+    assert entered.wait(10)  # engine lock is now held
+    results = {}
+
+    def probe():
+        results["healthz"] = service.handle(
+            protocol.parse_request('{"op": "healthz", "id": "h"}'))
+        results["metrics"] = service.handle(
+            protocol.parse_request('{"op": "metrics", "id": "m"}'))
+
+    prober = threading.Thread(target=probe, daemon=True)
+    prober.start()
+    prober.join(timeout=5)
+    blocked = prober.is_alive()
+    release.set()
+    worker.join(timeout=10)
+    assert not blocked, "scrape blocked behind the engine lock"
+    assert results["healthz"]["ok"] and results["healthz"]["healthy"]
+    assert "exposition" in results["metrics"]
+
+
+def test_busy_bounce_counts_and_correlates(tmp_path):
+    """A busy rejection still counts as an answered request AND a
+    rejection, and its reply + structured-log line share one
+    correlation id minted at admission."""
+    sink = str(tmp_path / "busy.slog")
+    slog.enable(sink)
+    service = _service(max_inflight=1)
+    assert service._gate.acquire(blocking=False)  # simulate one in flight
+    try:
+        reply = service.handle(protocol.parse_request(
+            '{"op": "analyze", "id": "b1", "code": "60"}'))
+    finally:
+        service._gate.release()
+    assert not reply["ok"] and reply["error"]["code"] == "busy"
+    cid = reply["correlation_id"]
+    assert cid
+    assert metrics.value("serve.requests") == 1
+    assert metrics.value("serve.busy_rejections") == 1
+    records = [json.loads(line) for line in open(sink, encoding="utf-8")]
+    busy = [r for r in records if r["event"] == "serve.busy"]
+    assert len(busy) == 1
+    assert busy[0]["cid"] == cid and busy[0]["request_id"] == "b1"
+
+
+def test_analyze_reply_and_slog_share_correlation_id(tmp_path,
+                                                     monkeypatch):
+    sink = str(tmp_path / "run.slog")
+    slog.enable(sink)
+    service = _service()
+    monkeypatch.setattr(service, "_run_analysis", _fake_payload)
+    reply = service.handle(protocol.parse_request(
+        '{"op": "analyze", "id": "a1", "code": "6001"}'))
+    assert reply["ok"]
+    cid = reply["correlation_id"]
+    assert cid
+    records = [json.loads(line) for line in open(sink, encoding="utf-8")]
+    by_event = {r["event"]: r for r in records}
+    assert by_event["serve.admitted"]["cid"] == cid
+    assert by_event["serve.reply"]["cid"] == cid
+    assert by_event["serve.reply"]["ok"] is True
+    assert by_event["serve.reply"]["issues"] == 0
+
+
+def test_http_shim_serves_healthz_and_metrics(monkeypatch):
+    from urllib.request import urlopen
+
+    from mythril_tpu.serve import http_shim
+
+    service = _service()
+    monkeypatch.setattr(service, "_run_analysis", _fake_payload)
+    ready = threading.Event()
+    thread = threading.Thread(
+        target=http_shim.serve_http, args=(service,),
+        kwargs={"port": 0, "ready_event": ready}, daemon=True)
+    thread.start()
+    assert ready.wait(10)
+    base = f"http://127.0.0.1:{service.http_port}"
+    try:
+        with urlopen(base + "/healthz", timeout=10) as response:
+            health = json.loads(response.read())
+        assert health["ok"] and health["healthy"]
+        with urlopen(base + "/metrics", timeout=10) as response:
+            content_type = response.headers["Content-Type"]
+            text = response.read().decode("utf-8")
+        assert content_type.startswith("text/plain; version=0.0.4")
+        assert "mythril_tpu_serve_requests_total" in text
+        assert "# HELP mythril_tpu_serve_requests " in text
+    finally:
+        service.shutting_down.set()
+        thread.join(timeout=10)
+    assert not thread.is_alive()
 
 
 def test_stdio_loop_replies_per_frame_and_honors_shutdown(monkeypatch):

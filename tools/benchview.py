@@ -1,0 +1,337 @@
+"""benchview — the perf-regression sentinel over the BENCH lineage.
+
+Reads the committed ``BENCH_r*.json`` history (one file per bench round:
+``{"n", "cmd", "rc", "tail", "parsed"}``), extracts every tracked
+headline number, renders the trend per metric, and exits non-zero when a
+number regressed beyond tolerance between consecutive *comparable* runs:
+
+    python -m tools.benchview                       # repo lineage
+    python -m tools.benchview --tolerance 0.1
+    python -m tools.benchview --metrics bench_metrics.json
+    python -m tools.benchview --self-check          # CI fixture gate
+
+Tracked numbers and their comparability keys:
+
+* the headline throughput (``sym_states_per_sec`` /
+  ``lockstep_lane_steps_per_sec``), keyed by (metric, backend,
+  n_branches, n_lanes) — a 4096-lane TPU run is never compared against
+  a 128-lane CPU run, so heterogeneous history stays green;
+* ``merge_ab.wall_speedup`` / ``merge_ab.states_ratio``, keyed by
+  (backend, chunk);
+* the corpus sweep medians and finding totals per engine, keyed by
+  (engine, budget_s).
+
+All tracked numbers are higher-is-better. A value that *drops* by more
+than ``--tolerance`` (default: the ``MYTHRIL_TPU_BENCH_TOLERANCE`` knob,
+0.2) between one run and the next run with the same key is a regression
+-> exit 1. Rounds without a parsed payload (timeouts, infra failures)
+are reported and skipped, never silently dropped.
+
+``--metrics`` additionally renders the solver-latency quantiles and XLA
+compile counts from a fresh ``bench_metrics.json`` snapshot (the file
+``bench.py`` writes beside its BENCH output) — display-only context, not
+gated, because snapshots are not part of the committed lineage.
+
+``--self-check`` builds a clean fixture lineage (must exit 0) and one
+with an injected >=20% throughput regression (must exit 1) in a temp
+directory and verifies both verdicts — the CI proof that the gate can
+actually fail. Stdlib + tpu_config only: no jax import, safe for any
+CI box.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # `python tools/benchview.py` form
+    sys.path.insert(0, _REPO)
+
+from mythril_tpu.support import tpu_config  # noqa: E402
+
+
+class Point(NamedTuple):
+    """One tracked number from one bench round."""
+
+    series: str        #: display name, e.g. "sym_states_per_sec"
+    key: tuple         #: comparability key (series + run configuration)
+    round_label: str   #: "r05"
+    value: float
+    unit: str
+
+
+class Regression(NamedTuple):
+    series: str
+    key: tuple
+    prev_label: str
+    prev_value: float
+    label: str
+    value: float
+    drop: float        #: fractional drop, e.g. 0.31
+
+
+def _num(value) -> Optional[float]:
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        return float(value)
+    return None
+
+
+def extract_points(round_label: str, run: dict) -> List[Point]:
+    """Every tracked number in one BENCH round's parsed payload."""
+    parsed = run.get("parsed")
+    if not isinstance(parsed, dict):
+        return []
+    points: List[Point] = []
+    metric = parsed.get("metric")
+    value = _num(parsed.get("value"))
+    if isinstance(metric, str) and value is not None:
+        key = (metric, parsed.get("backend"), parsed.get("n_branches"),
+               parsed.get("n_lanes"))
+        points.append(Point(metric, key, round_label, value,
+                            str(parsed.get("unit", ""))))
+    merge = parsed.get("merge_ab")
+    if isinstance(merge, dict):
+        for field in ("wall_speedup", "states_ratio"):
+            field_value = _num(merge.get(field))
+            if field_value is not None:
+                series = f"merge_ab.{field}"
+                key = (series, parsed.get("backend"), merge.get("chunk"))
+                points.append(Point(series, key, round_label,
+                                    field_value, "x"))
+    corpus = parsed.get("corpus")
+    if isinstance(corpus, dict):
+        for engine in sorted(corpus):
+            stats = corpus[engine]
+            if not isinstance(stats, dict):
+                continue
+            for field, unit in (("median_states_per_sec", "states/s"),
+                                ("total_swc_findings", "findings")):
+                field_value = _num(stats.get(field))
+                if field_value is not None:
+                    series = f"corpus.{engine}.{field}"
+                    key = (series, stats.get("budget_s"))
+                    points.append(Point(series, key, round_label,
+                                        field_value, unit))
+    return points
+
+
+def load_lineage(paths: List[str]) -> Tuple[List[Point], List[str]]:
+    """Points from every readable round, plus notes for skipped ones."""
+    points: List[Point] = []
+    notes: List[str] = []
+    for path in paths:
+        label = os.path.splitext(os.path.basename(path))[0]
+        label = label.replace("BENCH_", "")
+        try:
+            with open(path, encoding="utf-8") as handle:
+                run = json.load(handle)
+        except (OSError, ValueError) as error:
+            notes.append(f"{label}: unreadable ({error})")
+            continue
+        extracted = extract_points(label, run)
+        if not extracted:
+            rc = run.get("rc")
+            notes.append(f"{label}: no parsed payload (rc={rc}) — skipped")
+        points.extend(extracted)
+    return points, notes
+
+
+def build_series(points: List[Point]) -> Dict[tuple, List[Point]]:
+    """Points grouped by comparability key, lineage order preserved."""
+    series: Dict[tuple, List[Point]] = {}
+    for point in points:
+        series.setdefault(point.key, []).append(point)
+    return series
+
+
+def find_regressions(series: Dict[tuple, List[Point]],
+                     tolerance: float) -> List[Regression]:
+    """Consecutive same-key drops beyond tolerance (all tracked numbers
+    are higher-is-better)."""
+    regressions: List[Regression] = []
+    for key, run_points in series.items():
+        for prev, cur in zip(run_points, run_points[1:]):
+            if prev.value <= 0:
+                continue  # nothing meaningful to compare against
+            drop = (prev.value - cur.value) / prev.value
+            if drop > tolerance:
+                regressions.append(Regression(
+                    cur.series, key, prev.round_label, prev.value,
+                    cur.round_label, cur.value, drop))
+    return regressions
+
+
+def _fmt(value: float) -> str:
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return f"{value:g}"
+
+
+def render_trend(series: Dict[tuple, List[Point]], notes: List[str],
+                 regressions: List[Regression],
+                 tolerance: float) -> str:
+    lines = [f"benchview — BENCH lineage trend (tolerance {tolerance:.0%})"]
+    bad_keys = {(r.series, r.key, r.label) for r in regressions}
+    for key in sorted(series, key=lambda k: (series[k][0].series, str(k))):
+        run_points = series[key]
+        first = run_points[0]
+        config = ", ".join(str(part) for part in key[1:] if part is not None)
+        header = first.series + (f" [{config}]" if config else "")
+        rendered = []
+        for prev, cur in zip([None] + run_points[:-1], run_points):
+            cell = f"{cur.round_label}={_fmt(cur.value)}"
+            if prev is not None and prev.value > 0:
+                change = (cur.value - prev.value) / prev.value
+                cell += f" ({change:+.0%})"
+            if (cur.series, key, cur.round_label) in bad_keys:
+                cell += " <-- REGRESSION"
+            rendered.append(cell)
+        unit = f" {first.unit}" if first.unit else ""
+        lines.append(f"  {header}{unit}")
+        lines.append("    " + "  ->  ".join(rendered))
+    if notes:
+        lines.append("  skipped rounds:")
+        lines.extend(f"    {note}" for note in notes)
+    if regressions:
+        lines.append("  REGRESSIONS:")
+        for reg in regressions:
+            lines.append(
+                f"    {reg.series}: {reg.prev_label}={_fmt(reg.prev_value)}"
+                f" -> {reg.label}={_fmt(reg.value)}"
+                f" ({-reg.drop:+.0%}, tolerance -{tolerance:.0%})")
+    else:
+        lines.append("  no regressions beyond tolerance")
+    return "\n".join(lines)
+
+
+def render_metrics(path: str) -> str:
+    """Solver-latency quantiles + compile counts from a metrics
+    snapshot (display-only; tolerant of missing keys)."""
+    try:
+        with open(path, encoding="utf-8") as handle:
+            snapshot = json.load(handle)
+    except (OSError, ValueError) as error:
+        return f"  metrics snapshot {path}: unreadable ({error})"
+    lines = [f"  metrics snapshot ({path}):"]
+    flush = snapshot.get("dispatch.flush.latency_ms")
+    if isinstance(flush, dict) and flush.get("count"):
+        quantiles = "  ".join(
+            f"{q}={_fmt(float(flush[q]))}ms"
+            for q in ("p50", "p95", "p99") if q in flush)
+        lines.append(f"    solver flush latency: {quantiles}"
+                     f"  (n={flush['count']})")
+    occupancy = snapshot.get("dispatch.flush.occupancy")
+    if isinstance(occupancy, dict) and occupancy.get("count"):
+        lines.append(f"    flush occupancy: avg={occupancy.get('avg', 0):.1f}"
+                     f" p95={_fmt(float(occupancy.get('p95', 0)))}")
+    compiles = snapshot.get("xla.bucket_compiles", 0)
+    reuses = snapshot.get("xla.bucket_reuses", 0)
+    lines.append(f"    compile counts: {int(compiles)} cold buckets,"
+                 f" {int(reuses)} warm hits")
+    if len(lines) == 1:
+        lines.append("    (no tracked series in snapshot)")
+    return "\n".join(lines)
+
+
+def check_lineage(paths: List[str], tolerance: float,
+                  metrics_path: Optional[str] = None) -> Tuple[str, int]:
+    """(report text, exit code) for one lineage."""
+    points, notes = load_lineage(paths)
+    if not points and not notes:
+        return "benchview: no BENCH_r*.json lineage found", 2
+    series = build_series(points)
+    regressions = find_regressions(series, tolerance)
+    report = render_trend(series, notes, regressions, tolerance)
+    if metrics_path and os.path.exists(metrics_path):
+        report += "\n" + render_metrics(metrics_path)
+    return report, (1 if regressions else 0)
+
+
+def _selfcheck_round(directory: str, index: int, value: float) -> str:
+    path = os.path.join(directory, f"BENCH_r{index:02d}.json")
+    payload = {
+        "n": index, "cmd": "selfcheck", "rc": 0, "tail": "",
+        "parsed": {"metric": "sym_states_per_sec", "value": value,
+                   "unit": "states/s", "backend": "cpu",
+                   "n_branches": 10, "n_lanes": 128},
+    }
+    with open(path, "w", encoding="utf-8") as handle:
+        json.dump(payload, handle)
+    return path
+
+
+def self_check(tolerance: float) -> int:
+    """Fixture gate: a clean lineage must pass, an injected >=20%
+    regression must fail. Proves the sentinel can actually fire."""
+    with tempfile.TemporaryDirectory(prefix="benchview-") as tmp:
+        clean = [_selfcheck_round(tmp, i + 1, v)
+                 for i, v in enumerate((100.0, 105.0, 103.0))]
+        report, code = check_lineage(clean, tolerance)
+        if code != 0:
+            print(report)
+            print("benchview self-check: FAIL — clean lineage "
+                  f"exited {code}, expected 0", file=sys.stderr)
+            return 1
+        regressed = [_selfcheck_round(tmp, 10 + i, v)
+                     for i, v in enumerate((100.0, 102.0, 60.0))]
+        report, code = check_lineage(regressed, tolerance)
+        if code != 1:
+            print(report)
+            print("benchview self-check: FAIL — injected 41% regression "
+                  f"exited {code}, expected 1", file=sys.stderr)
+            return 1
+    print("benchview self-check: ok (clean lineage passes, injected "
+          "regression fails)")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.benchview",
+        description="Perf-regression sentinel over the BENCH_r*.json "
+                    "lineage.")
+    parser.add_argument("lineage", nargs="*",
+                        help="BENCH round files, lineage order (default: "
+                             "BENCH_r*.json at the repo root)")
+    parser.add_argument("--tolerance", type=float, default=None,
+                        help="relative drop that counts as a regression "
+                             "(default: MYTHRIL_TPU_BENCH_TOLERANCE)")
+    parser.add_argument("--metrics", default=None,
+                        help="bench_metrics.json snapshot to render "
+                             "solver-latency quantiles from (display "
+                             "only)")
+    parser.add_argument("--self-check", action="store_true",
+                        help="verify the gate on fixture lineages "
+                             "(clean -> 0, injected regression -> 1)")
+    args = parser.parse_args(argv)
+
+    tolerance = args.tolerance
+    if tolerance is None:
+        tolerance = tpu_config.get_float("MYTHRIL_TPU_BENCH_TOLERANCE")
+    if tolerance <= 0:
+        print("benchview: tolerance must be positive", file=sys.stderr)
+        return 2
+
+    if args.self_check:
+        return self_check(tolerance)
+
+    paths = args.lineage or sorted(
+        glob.glob(os.path.join(_REPO, "BENCH_r*.json")))
+    metrics_path = args.metrics
+    if metrics_path is None:
+        default_metrics = os.path.join(_REPO, "bench_metrics.json")
+        if os.path.exists(default_metrics):
+            metrics_path = default_metrics
+    report, code = check_lineage(paths, tolerance, metrics_path)
+    print(report)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,7 +35,15 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu \
     python -m tools.merge_smoke || exit $?
 
 echo
-echo "== serve smoke (daemon start -> request -> clean shutdown) =="
+echo "== benchview self-check (injected regression must trip the gate) =="
+timeout -k 10 60 python -m tools.benchview --self-check || exit $?
+
+echo
+echo "== benchview (perf-regression sentinel over BENCH_r*.json) =="
+timeout -k 10 60 python -m tools.benchview || exit $?
+
+echo
+echo "== serve smoke (daemon start -> request -> metrics scrape -> clean shutdown) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu \
     python -m tools.serve_smoke || exit $?
 

@@ -252,8 +252,11 @@ def _metrics_slice(snapshot: Dict[str, object], prefix: str,
             for label, stats in sorted(
                     value.items(),
                     key=lambda kv: -float(kv[1].get("sum", 0) or 0)):
-                lines.append(f"    {label:<16} sum {stats.get('sum', 0):>12} "
-                             f" x{stats.get('count', 0)}")
+                line = (f"    {label:<16} sum {stats.get('sum', 0):>12} "
+                        f" x{stats.get('count', 0)}")
+                if "p95" in stats:
+                    line += f"  p95 {stats['p95']}"
+                lines.append(line)
         elif isinstance(value, dict):
             detail = ", ".join(f"{k}={v}" for k, v in sorted(value.items()))
             lines.append(f"  {short:<24} {detail}")

@@ -7,12 +7,14 @@ emission of an undeclared name would raise ``KeyError`` at runtime, but
 only on the code path that emits it; this rule moves that failure to lint
 time, for every path, including the cold ones tests never walk.
 
-Checked: every call to ``inc`` / ``set_gauge`` / ``observe`` on a module
-imported from ``mythril_tpu.observe`` (``metrics.inc(...)``, an aliased
-``from ... import metrics as m``, or a from-imported ``inc(...)``) whose
-first argument is a string literal must name a metric in ``REGISTRY``.
-Dynamic names (the ``set_value`` facade write path, loops over
-``FACADE_METRICS``) are the registry's runtime ``KeyError`` contract's
+Checked: every call to an emitter (``inc`` / ``set_gauge`` /
+``observe``) or a reader (``value`` / ``set_value`` / ``histogram`` /
+``labels`` / ``quantile`` — the exporter-side surface ISSUE 12 added) on
+a module imported from ``mythril_tpu.observe`` (``metrics.inc(...)``, an
+aliased ``from ... import metrics as m``, or a from-imported
+``inc(...)``) whose first argument is a string literal must name a
+metric in ``REGISTRY``. Dynamic names (loops over ``FACADE_METRICS``,
+f-string families) are the registry's runtime ``KeyError`` contract's
 problem, not this rule's.
 """
 
@@ -30,6 +32,11 @@ SCAN_DIRS = ("mythril_tpu", "tools", "tests", "bench.py")
 
 #: emission calls whose first positional argument is a metric name
 EMITTERS = ("inc", "set_gauge", "observe")
+
+#: read-side calls (exporter, views, bench extras) audited the same way
+READERS = ("value", "set_value", "histogram", "labels", "quantile")
+
+AUDITED = EMITTERS + READERS
 
 
 def load_registry() -> Set[str]:
@@ -70,7 +77,7 @@ def _emitter_imports(tree: ast.AST) -> Set[str]:
                 (node.module == "metrics"
                  or node.module.endswith(".metrics")):
             for name in node.names:
-                if name.name in EMITTERS:
+                if name.name in AUDITED:
                     out.add(name.asname or name.name)
     return out
 
@@ -91,17 +98,17 @@ def check_file(relpath: str, tree: ast.AST,
                 and arg.value not in registry:
             violations.append(Violation(
                 "R6", relpath, node.lineno,
-                f"{how} emits undeclared metric {arg.value!r} — declare "
-                "it in mythril_tpu/observe/metrics.py (name, kind, unit, "
-                "docstring) or fix the typo; undeclared emissions raise "
-                "KeyError at runtime",
+                f"{how} references undeclared metric {arg.value!r} — "
+                "declare it in mythril_tpu/observe/metrics.py (name, "
+                "kind, unit, docstring) or fix the typo; undeclared "
+                "references raise KeyError at runtime",
                 where=arg.value, key=f"R6:{relpath}:{arg.value}"))
 
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
         func = node.func
-        if isinstance(func, ast.Attribute) and func.attr in EMITTERS \
+        if isinstance(func, ast.Attribute) and func.attr in AUDITED \
                 and isinstance(func.value, ast.Name) \
                 and func.value.id in aliases:
             check_call(node, f"{func.value.id}.{func.attr}")
@@ -113,9 +120,10 @@ def check_file(relpath: str, tree: ast.AST,
 class MetricsRegistryRule(LintRule):
     code = "R6"
     name = "metrics-registry"
-    description = ("every metric emitted via observe.metrics "
-                   "inc/set_gauge/observe must be declared in "
-                   "mythril_tpu/observe/metrics.py")
+    description = ("every metric referenced via observe.metrics "
+                   "emitters (inc/set_gauge/observe) or readers "
+                   "(value/set_value/histogram/labels/quantile) must "
+                   "be declared in mythril_tpu/observe/metrics.py")
 
     def run(self, ctx: LintContext) -> List[Violation]:
         registry = load_registry()

@@ -5,9 +5,12 @@ Full process-level lifecycle, CPU-only and CDCL-only so it stays cheap:
 1. start `myth-tpu serve` (unix-socket mode, warmup on over an empty
    manifest) as a subprocess;
 2. wait for the socket, then send ping + one analyze request for the
-   mini killable contract + shutdown over one client connection;
-3. require the analyze reply to find the SELFDESTRUCT issue and the
-   daemon to exit 0 after the drain.
+   mini killable contract + a metrics scrape + shutdown over one client
+   connection;
+3. require the analyze reply to find the SELFDESTRUCT issue (carrying a
+   correlation id that also shows up in the structured log), the metrics
+   reply to carry a Prometheus exposition that mentions the request
+   counter, and the daemon to exit 0 after the drain.
 
 Prints ``SERVE_SMOKE=ok`` on success; any failure exits non-zero with a
 diagnostic. The caller bounds the wall clock (check.sh wraps this in
@@ -43,7 +46,9 @@ def main() -> int:
     workdir = tempfile.mkdtemp(prefix="serve_smoke_")
     socket_path = os.path.join(workdir, "serve.sock")
     manifest_path = os.path.join(workdir, "warmset.json")
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    slog_path = os.path.join(workdir, "serve.slog")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MYTHRIL_TPU_SLOG=slog_path)
     daemon = subprocess.Popen(
         [sys.executable, "-m", "mythril_tpu.interfaces.cli", "serve",
          "--socket", socket_path, "--manifest", manifest_path],
@@ -66,6 +71,7 @@ def main() -> int:
              {"op": "analyze", "id": "smoke-analyze",
               "code": _mini_contract(), "transaction_count": 2,
               "deadline_ms": 120_000},
+             {"op": "metrics", "id": "smoke-metrics"},
              {"op": "shutdown", "id": "smoke-shutdown"}],
             socket_path=socket_path, timeout=120)
 
@@ -77,6 +83,25 @@ def main() -> int:
             problems.append(f"expected >=1 issue, got {analyze}")
         if "warm" not in analyze:
             problems.append(f"no warm/cold accounting in {analyze}")
+        cid = analyze.get("correlation_id", "")
+        if not cid:
+            problems.append(f"analyze reply carries no correlation_id: "
+                            f"{analyze}")
+        scrape = replies[2]
+        exposition = scrape.get("exposition", "")
+        if "mythril_tpu_serve_requests_total" not in exposition:
+            problems.append("metrics exposition lacks the request counter:"
+                            f" {exposition[:400]!r}")
+        if not str(scrape.get("content_type", "")).startswith("text/plain"):
+            problems.append(f"bad metrics content_type in {scrape}")
+        try:
+            with open(slog_path, encoding="utf-8") as handle:
+                slog_text = handle.read()
+        except OSError:
+            slog_text = ""
+        if cid and cid not in slog_text:
+            problems.append(f"correlation id {cid!r} absent from slog "
+                            f"{slog_path}")
         daemon.wait(timeout=30)
         if daemon.returncode != 0:
             problems.append(f"daemon exited {daemon.returncode}:\n"
@@ -89,7 +114,7 @@ def main() -> int:
                   file=sys.stderr)
             return 1
         print(f"SERVE_SMOKE=ok issues={analyze['issue_count']} "
-              f"elapsed_ms={analyze.get('elapsed_ms')}")
+              f"elapsed_ms={analyze.get('elapsed_ms')} cid={cid}")
         return 0
     finally:
         if daemon.poll() is None:

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
@@ -260,15 +261,29 @@ def _serve_section(spans: List[dict]) -> List[str]:
         lines.append(line)
     if not warmups:
         lines.append("  (no warmup span — daemon started with warmup off)")
+    if requests:
+        durations = sorted(float(s.get("dur", 0.0)) for s in requests)
+
+        def _q(q: float) -> float:
+            # nearest-rank, matching metrics._Hist.quantile
+            rank = math.ceil(q * len(durations)) - 1
+            return durations[max(0, min(rank, len(durations) - 1))]
+
+        lines.append(
+            f"  request latency ({len(durations)} request(s)): "
+            f"p50 {_fmt_us(_q(0.5))}  p95 {_fmt_us(_q(0.95))}  "
+            f"p99 {_fmt_us(_q(0.99))}")
     for request in sorted(requests, key=lambda s: float(s.get("ts", 0.0))):
         args = request.get("args", {})
         start = float(request.get("ts", 0.0))
         dur = float(request.get("dur", 0.0))
+        cid = args.get("correlation_id")
         lines.append(
             f"  request {args.get('request_id', '?')}: {_fmt_us(dur)}  "
             f"cold_buckets={args.get('cold_buckets', '?')} "
             f"warm_hits={args.get('warm_hits', '?')} "
-            f"issues={args.get('issues', '?')}")
+            f"issues={args.get('issues', '?')}"
+            + (f" cid={cid}" if cid else ""))
         inner = [
             s for s in spans
             if s is not request and not s["name"].startswith("serve.")
