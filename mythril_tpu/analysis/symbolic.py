@@ -45,7 +45,8 @@ class SymExecWrapper:
                  run_analysis_modules: bool = True, enable_coverage_strategy: bool = False,
                  custom_modules_directory: str = "", engine: str = "host",
                  checkpoint_path: Optional[str] = None,
-                 resume_path: Optional[str] = None):
+                 resume_path: Optional[str] = None,
+                 fleet=None):
         if isinstance(address, str):
             address = symbol_factory.BitVecVal(int(address, 16), 256)
         elif isinstance(address, int):
@@ -66,13 +67,17 @@ class SymExecWrapper:
             len(ModuleLoader().get_detection_modules(
                 EntryPoint.POST, modules)) > 0
         self.modules = modules
-        tx_id_manager.restart_counter()
-        # a fresh analysis must not inherit another's keccak axioms: with
-        # restarted tx ids, symbol names recur and stale concrete-hash
-        # conditions would conflict with this run's (making everything unsat)
-        from ..core.function_managers import keccak_function_manager
+        if fleet is None:
+            tx_id_manager.restart_counter()
+            # a fresh analysis must not inherit another's keccak axioms: with
+            # restarted tx ids, symbol names recur and stale concrete-hash
+            # conditions would conflict with this run's (making everything
+            # unsat)
+            from ..core.function_managers import keccak_function_manager
 
-        keccak_function_manager.reset()
+            keccak_function_manager.reset()
+        # fleet members get fresh tx/keccak namespaces from the driver's
+        # per-turn swap; restarting here would clobber the swapped-in state
 
         # non-incremental exploration: the RF prioritizer predicts which
         # function sequence to explore (reference symbolic.py:107-110)
@@ -95,6 +100,8 @@ class SymExecWrapper:
             checkpoint_path=checkpoint_path,
             resume_path=resume_path,
         )
+        if fleet is not None:
+            fleet.install(self.laser)
         if loop_bound is not None:
             self.laser.extend_strategy(BoundedLoopsStrategy,
                                        loop_bound=loop_bound)

@@ -188,7 +188,9 @@ class LaserEVM:
             from ..support.checkpoint import (load_host_checkpoint,
                                               restore_into_laser)
 
-            payload = load_host_checkpoint(self.resume_path)
+            payload = load_host_checkpoint(
+                self.resume_path,
+                expected_contract_id=getattr(self, "contract_id", None))
             if payload is not None:
                 start_tx, pending_work_list = restore_into_laser(payload, self)
             self.resume_path = None  # consume once
@@ -235,10 +237,18 @@ class LaserEVM:
             with trace.span("svm.tx", index=i, engine=self.engine,
                             states=len(self.open_states)):
                 if self.engine == "tpu":
-                    from ..parallel.frontier import execute_message_call_tpu
+                    gate = getattr(self, "fleet_gate", None)
+                    if gate is not None:
+                        # fleet member: the driver seeds this contract's
+                        # lanes into the shared frontier and runs the
+                        # device phase for all packed contracts at once
+                        gate(self, address, func_hashes=hashes)
+                    else:
+                        from ..parallel.frontier import \
+                            execute_message_call_tpu
 
-                    execute_message_call_tpu(self, address,
-                                             func_hashes=hashes)
+                        execute_message_call_tpu(self, address,
+                                                 func_hashes=hashes)
                 else:
                     execute_message_call(self, address, func_hashes=hashes)
             for hook in self._stop_sym_trans_hooks:

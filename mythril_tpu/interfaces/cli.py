@@ -24,8 +24,9 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
     inputs.add_argument("solidity_files", nargs="*",
                         help=".sol files (optionally file:ContractName)")
     inputs.add_argument("-c", "--code", help="hex creation bytecode")
-    inputs.add_argument("-f", "--codefile",
-                        help="file containing hex bytecode")
+    inputs.add_argument("-f", "--codefile", action="append",
+                        help="file containing hex bytecode (repeatable: "
+                             "with --fleet every -f is one corpus member)")
     inputs.add_argument("-a", "--address", help="on-chain contract address")
     inputs.add_argument("--bin-runtime", action="store_true",
                         help="treat -c/-f input as runtime (deployed) code")
@@ -99,6 +100,13 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
     options.add_argument("--engine", default="host", choices=["host", "tpu"],
                          help="exploration engine: host worklist or the "
                               "batched TPU symbolic frontier")
+    options.add_argument("--fleet", action="store_true",
+                         help="pack ALL loaded contracts (multiple .sol "
+                              "inputs or repeated -f) into ONE device "
+                              "frontier with shared solver dispatch "
+                              "(parallel/frontier.py FleetDriver); needs "
+                              "--engine tpu; per-contract detections stay "
+                              "byte-identical to sequential runs")
     options.add_argument("--beam-width", type=int, default=None)
     options.add_argument("--transaction-sequences", default=None,
                          help="explicit function-sequence list (json)")
@@ -161,10 +169,16 @@ def _load_contracts(parser, cli_args, disassembler):
         address, _ = disassembler.load_from_bytecode(
             cli_args.code, cli_args.bin_runtime, address)
     elif cli_args.codefile:
-        with open(cli_args.codefile) as handle:
-            code = handle.read().strip()
-        address, _ = disassembler.load_from_bytecode(
-            code, cli_args.bin_runtime, address)
+        for path in cli_args.codefile:
+            with open(path) as handle:
+                code = handle.read().strip()
+            address, contract = disassembler.load_from_bytecode(
+                code, cli_args.bin_runtime, address)
+            if len(cli_args.codefile) > 1:
+                # corpus sweep: name each member after its file so fleet
+                # namespaces/reports stay distinguishable
+                contract.name = os.path.splitext(os.path.basename(path))[0]
+                contract.input_file = path
     elif cli_args.address:
         address, _ = disassembler.load_from_address(cli_args.address)
     elif cli_args.solidity_files:
@@ -268,6 +282,13 @@ def _add_serve_args(parser: argparse.ArgumentParser) -> None:
     daemon.add_argument("--max-inflight", type=int, default=None,
                         help="admitted-but-unfinished request bound "
                              "(default: MYTHRIL_TPU_SERVE_MAX_INFLIGHT)")
+    daemon.add_argument("--fleet", action="store_true",
+                        help="micro-batch concurrent compatible analyze "
+                             "requests into one shared fleet step instead "
+                             "of serializing them on the engine lock (same "
+                             "as MYTHRIL_TPU_FLEET_SERVE=1; join window / "
+                             "batch size via MYTHRIL_TPU_FLEET_WINDOW_MS / "
+                             "MYTHRIL_TPU_FLEET_MAX_BATCH)")
 
 
 def _cmd_serve(cli_args) -> int:
@@ -279,7 +300,8 @@ def _cmd_serve(cli_args) -> int:
         strategy=cli_args.strategy,
         manifest_path=cli_args.manifest or default_manifest_path(),
         warmup=False if cli_args.no_warmup else None,
-        max_inflight=cli_args.max_inflight)
+        max_inflight=cli_args.max_inflight,
+        fleet=True if cli_args.fleet else None)
     if cli_args.stdio:
         from ..serve.daemon import serve_stdio
 

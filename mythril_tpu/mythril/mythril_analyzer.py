@@ -39,6 +39,7 @@ class MythrilAnalyzer:
         self.create_timeout = getattr(cmd, "create_timeout", 10)
         self.max_depth = getattr(cmd, "max_depth", 128)
         self.engine = getattr(cmd, "engine", "host") or "host"
+        self.fleet = getattr(cmd, "fleet", False)
         self.checkpoint_path = getattr(cmd, "checkpoint", None)
         self.resume_path = getattr(cmd, "resume", None)
         self.disable_dependency_pruning = getattr(
@@ -136,6 +137,19 @@ class MythrilAnalyzer:
         exceptions = []
         incomplete = False
         coverage: dict = {}
+        if self.fleet and self.engine == "tpu" and len(self.contracts) >= 2:
+            results = self.fleet_contract_results(modules, transaction_count)
+            for entry in results:
+                exceptions.extend(entry["exceptions"])
+                if entry["timed_out"]:
+                    incomplete = True
+                    coverage = entry["coverage"]
+                all_issues.extend(entry["issues"])
+            return self._assemble_report(all_issues, exceptions, incomplete,
+                                         coverage)
+        if self.fleet:
+            log.info("fleet mode needs engine=tpu and >= 2 contracts; "
+                     "running sequentially")
         for contract in self.contracts:
             SolverStatistics().reset()
             sym = None
@@ -189,6 +203,11 @@ class MythrilAnalyzer:
                 issue.add_code_info(contract)
             all_issues.extend(issues)
 
+        return self._assemble_report(all_issues, exceptions, incomplete,
+                                     coverage)
+
+    def _assemble_report(self, all_issues: List[Issue], exceptions,
+                         incomplete: bool, coverage: dict) -> Report:
         source_data = [getattr(c, "input_file", c.name)
                        for c in self.contracts]
         report = Report(contracts=self.contracts, exceptions=exceptions)
@@ -206,6 +225,139 @@ class MythrilAnalyzer:
 
             metrics.write_snapshot(self.metrics_out)
         return report
+
+    # -- fleet mode --------------------------------------------------------------------
+
+    def fleet_contract_results(self, modules: Optional[List[str]] = None,
+                               transaction_count: int = 2) -> List[dict]:
+        """Analyze every loaded contract as ONE fleet: all contracts share
+        a single device frontier and the merged solver dispatch queue
+        (parallel/frontier.py FleetDriver), while per-turn singleton swaps
+        keep each contract's detections byte-identical to a solo run.
+
+        Returns one dict per contract, in contract order:
+        ``{contract, contract_id, issues, exceptions, timed_out, coverage}``
+        — `fire_lasers` folds these into the combined Report; `serve`'s
+        micro-batcher demuxes them into per-request reports."""
+        from ..parallel.frontier import FleetDriver, FleetMember
+
+        contract_ids = _unique_contract_ids(self.contracts)
+        SolverStatistics().reset()
+        members: List[FleetMember] = []
+        for index, (contract, cid) in enumerate(
+                zip(self.contracts, contract_ids)):
+            member = FleetMember(index, cid,
+                                 execution_timeout=self.execution_timeout
+                                 or 0)
+            member.work = self._make_member_work(member, contract, modules,
+                                                 transaction_count)
+            members.append(member)
+        driver = FleetDriver(members, modules=modules)
+        log.info("fleet: packing %d contracts into one device frontier: %s",
+                 len(members), ", ".join(contract_ids))
+        with trace.span("analyze.fleet", contracts=len(members)):
+            try:
+                driver.run()
+            except KeyboardInterrupt:
+                log.critical(
+                    "fleet analysis interrupted, saving issues found so far")
+        log.info("solver statistics: %s", SolverStatistics())
+        results = []
+        for member, contract in zip(members, self.contracts):
+            entry = {"contract": contract, "contract_id": member.contract_id,
+                     "issues": [], "exceptions": [], "timed_out": False,
+                     "coverage": {}}
+            if member.traceback_str:
+                entry["exceptions"].append(member.traceback_str)
+            if member.result is not None:
+                entry["issues"] = list(member.result)
+            elif member.error is not None:
+                # the work closure never reached its own harvest (driver
+                # abort / unexpected BaseException): partial harvest from
+                # the member's swapped-out snapshots
+                for saved in member.module_state.values():
+                    entry["issues"].extend(saved["issues"])
+            laser = member.gate_laser or member.laser
+            if laser is not None and getattr(laser, "timed_out", False):
+                entry["timed_out"] = True
+                entry["coverage"] = {
+                    "executed_nodes": laser.executed_nodes,
+                    "explored_states": laser.total_states,
+                    "dropped_states": getattr(laser, "dropped_states", 0),
+                    "open_states": len(laser.open_states),
+                    "transactions_reached":
+                        getattr(laser, "_current_tx_index", 0) + 1,
+                }
+                log.warning("fleet analysis of %s is INCOMPLETE (deadline "
+                            "drain): %s", member.contract_id,
+                            entry["coverage"])
+            for issue in entry["issues"]:
+                issue.add_code_info(contract)
+            results.append(entry)
+        return results
+
+    def _make_member_work(self, member, contract, modules,
+                          transaction_count: int):
+        """The member-thread body: an unchanged solo analysis of one
+        contract, except SymExecWrapper(fleet=member) routes its device
+        phases through the shared fleet gate. Exceptions are handled HERE
+        (on the member's turn, under its swapped-in detector state) so the
+        partial harvest matches the sequential loop's."""
+        checkpoint_path = resume_path = None
+        if self.checkpoint_path:
+            checkpoint_path = f"{self.checkpoint_path}.{member.contract_id}"
+        if self.resume_path:
+            resume_path = f"{self.resume_path}.{member.contract_id}"
+
+        def work():
+            try:
+                sym = SymExecWrapper(
+                    contract,
+                    self.address,
+                    self.strategy,
+                    dynloader=self._dynloader(),
+                    max_depth=self.max_depth,
+                    execution_timeout=self.execution_timeout,
+                    loop_bound=self.loop_bound,
+                    create_timeout=self.create_timeout,
+                    transaction_count=transaction_count,
+                    modules=modules,
+                    compulsory_statespace=False,
+                    disable_dependency_pruning=self.disable_dependency_pruning,
+                    custom_modules_directory=self.custom_modules_directory,
+                    engine=self.engine,
+                    checkpoint_path=checkpoint_path,
+                    resume_path=resume_path,
+                    fleet=member)
+                return fire_lasers(sym, modules)
+            except KeyboardInterrupt:
+                log.critical("analysis of %s interrupted, saving issues "
+                             "found so far", member.contract_id)
+                return retrieve_callback_issues(modules)
+            except Exception:
+                log.exception("exception during %s fleet analysis",
+                              member.contract_id)
+                member.traceback_str = traceback.format_exc()
+                member.error = RuntimeError(
+                    f"fleet member {member.contract_id} failed")
+                return retrieve_callback_issues(modules)
+
+        return work
+
+
+def _unique_contract_ids(contracts) -> List[str]:
+    """Stable, filesystem/metric-safe, UNIQUE per-contract namespace ids
+    (checkpoint suffixes, telemetry labels, dispatch query origins)."""
+    ids: List[str] = []
+    seen: dict = {}
+    for index, contract in enumerate(contracts):
+        base = "".join(ch if ch.isalnum() or ch in "._-" else "_"
+                       for ch in (getattr(contract, "name", "") or ""))
+        base = base or f"contract{index}"
+        count = seen.get(base, 0)
+        seen[base] = count + 1
+        ids.append(base if count == 0 else f"{base}-{count + 1}")
+    return ids
 
 
 class _Namespace:

@@ -97,6 +97,10 @@ _METRICS: List[MetricSpec] = [
                "Unique queries per batched device flush."),
     MetricSpec("dispatch.flush.latency_ms", HISTOGRAM, "ms",
                "Wall time of one batched device flush."),
+    MetricSpec("dispatch.flush.contracts", HISTOGRAM, "contracts",
+               "Distinct contracts whose queries shared one batched "
+               "device flush (fleet mode tags submissions by origin; "
+               ">= 2 means the batch was genuinely merged)."),
     # -- resilience / failure domains (support/resilience.py) --------------------
     MetricSpec("resilience.device_skipped", COUNTER, "1",
                "Queries skipped because a breaker was OPEN/QUARANTINED."),
@@ -165,6 +169,18 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("frontier.telemetry.tag_occupancy", HISTOGRAM, "1",
                "Per-chunk running-lane-steps at tagged merge-point / "
                "loop-header pcs (label = merge@pc / loop@pc)."),
+    # -- fleet packing (parallel/frontier.py FleetDriver) ------------------------
+    MetricSpec("frontier.fleet.contracts", GAUGE, "contracts",
+               "Contracts packed into the in-flight fleet frontier."),
+    MetricSpec("frontier.fleet.lane_steps", HISTOGRAM, "1",
+               "Per-chunk running-lane-steps per packed contract "
+               "(label = contract id; the fairness signal)."),
+    MetricSpec("frontier.fleet.drained", COUNTER, "lanes",
+               "Lanes killed by the per-contract deadline drain (the "
+               "owning contract's budget expired; lanes freed for the "
+               "others)."),
+    MetricSpec("frontier.fleet.phases", COUNTER, "1",
+               "Shared device phases run by the fleet driver."),
     # -- on-device state merging (parallel/symstep.py merge_pass) ----------------
     MetricSpec("frontier.merge.passes", COUNTER, "1",
                "Merge-pass invocations dispatched to the device "
@@ -261,6 +277,12 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("serve.metrics_scrapes", COUNTER, "1",
                "Metrics scrapes answered (GET /metrics or the `metrics` "
                "protocol op); never takes the engine lock."),
+    MetricSpec("serve.fleet.batched", COUNTER, "1",
+               "Analysis requests that joined a fleet micro-batch "
+               "instead of queueing on the engine lock."),
+    MetricSpec("serve.fleet.windows", COUNTER, "1",
+               "Fleet micro-batch windows closed (one shared fleet run "
+               "each, leader request included)."),
     # -- engine plugins (core/plugin/plugins/) -----------------------------------
     MetricSpec("profiler.instruction_us", HISTOGRAM, "us",
                "Per-opcode host-engine instruction latency "

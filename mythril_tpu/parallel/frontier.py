@@ -36,6 +36,9 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
+import traceback
+from contextlib import contextmanager
 from copy import copy
 from typing import Dict, List, Optional, Tuple
 
@@ -99,10 +102,11 @@ def _summary(state, planes, arena, sched):
     costs ~400 ms while this single [13 + 2B] download costs one floor.
     Layout: [stack_top, esc_count, executed, forks, pushes, pops, arena_n,
     arena_n_const, esc_msize_max, esc_sp_max, esc_slots_max, esc_conds_max,
-    batch] then status[B] then fork_cond[B], then — only when the
-    telemetry plane is armed — symstep.telemetry_words(sched.telemetry)
-    appended at the END (existing offsets stay valid; the counters ride
-    the same single download, zero extra host syncs)."""
+    batch] then status[B] then fork_cond[B] then ctx_id[B] (the fleet
+    deadline drain reads lane ownership from it every chunk), then — only
+    when the telemetry plane is armed — symstep.telemetry_words(
+    sched.telemetry) appended at the END (the counters ride the same
+    single download, zero extra host syncs)."""
     esc_rows = sched.esc_state.status.shape[0]
     live = jnp.arange(esc_rows) < sched.esc_count
 
@@ -122,7 +126,8 @@ def _summary(state, planes, arena, sched):
         jnp.asarray(batch, dtype=jnp.int64),
     ])
     packed = jnp.concatenate([scalars, state.status.astype(jnp.int64),
-                              planes.fork_cond.astype(jnp.int64)])
+                              planes.fork_cond.astype(jnp.int64),
+                              planes.ctx_id.astype(jnp.int64)])
     if sched.telemetry is not None:
         packed = jnp.concatenate(
             [packed, symstep.telemetry_words(sched.telemetry)])
@@ -471,6 +476,15 @@ class _Frontier:
         self.merges = 0     # pairs collapsed (one lane retired each)
         #: last chunk's per-tag occupancy deltas (merge-pass trigger)
         self._last_tag_delta: Optional[np.ndarray] = None
+        #: fleet packing (FleetDriver): when set, contexts carry per-member
+        #: lasers, the chunk loop runs the per-contract deadline drain, and
+        #: the telemetry plane grows a per-contract occupancy block
+        self.fleet = None
+        #: host-side names for the fleet occupancy slots (contract ids) —
+        #: parallel to Telemetry.fleet_occ
+        self.fleet_names: List[str] = []
+        #: last chunk's per-contract occupancy deltas (frontierview feed)
+        self._last_fleet_delta: Optional[np.ndarray] = None
 
     def _harena(self, used=None, used_const=None) -> A.HostArena:
         """The persistent incremental host mirror of the arena (term memo
@@ -509,9 +523,13 @@ class _Frontier:
         telemetry = None
         if self.telemetry_enabled:
             tag_pcs, self.tag_names = self._collect_tag_pcs()
-            telemetry = symstep.new_telemetry(tag_pcs)
+            fleet_slots, self.fleet_names = self._collect_fleet_slots()
+            telemetry = symstep.new_telemetry(
+                tag_pcs, fleet_slots=fleet_slots,
+                n_fleet=len(self.fleet_names))
             self._tel_prev = None  # device counters restart each phase
             self._last_tag_delta = None
+            self._last_fleet_delta = None
         return symstep.new_scheduler(state, planes, stack_rows, esc_rows,
                                      telemetry=telemetry)
 
@@ -552,6 +570,32 @@ class _Frontier:
                      len(tags), len(tags) + dropped, dropped,
                      self.TAG_SLOTS)
         return [pc for pc, _ in tags], [name for _, name in tags]
+
+    def _collect_fleet_slots(self) -> Tuple[List[int], List[str]]:
+        """Per-contract occupancy slots: map every seeding context to its
+        fleet member's slot (same ≤32-slot counter mechanism as the tag
+        table). Empty outside fleet mode — solo runs pay zero extra
+        summary words."""
+        if self.fleet is None:
+            return [], []
+        slots: List[int] = []
+        names: List[str] = []
+        index_of: Dict[str, int] = {}
+        for ctx in self.contexts:
+            member = getattr(ctx, "member", None)
+            cid = member.contract_id if member is not None \
+                else "(unowned)"
+            if cid not in index_of:
+                if len(names) >= self.TAG_SLOTS:
+                    log.info("frontier fleet telemetry: contract %r past "
+                             "the %d-slot cap, folding into last slot",
+                             cid, self.TAG_SLOTS)
+                    slots.append(len(names) - 1)
+                    continue
+                index_of[cid] = len(names)
+                names.append(cid)
+            slots.append(index_of[cid])
+        return slots, names
 
     #: merge-attribution table cap (one P x K compare per merge round)
     MERGE_PC_SLOTS = 64
@@ -751,6 +795,14 @@ class _Frontier:
             or (f"{host_ckpt}.device" if host_ckpt else None)
         resume_path = tpu_config.get_str("MYTHRIL_TPU_RESUME") \
             or (f"{host_resume}.device" if host_resume else None)
+        if self.fleet is not None and (checkpoint_path or resume_path):
+            # a shared multi-contract wave must not land in ONE npz under
+            # the primary's name: fleet resume rides the per-contract HOST
+            # checkpoints (contract_id-stamped, support/checkpoint.py)
+            log.info("fleet mode: device checkpoints disabled; per-contract "
+                     "host checkpoints carry resume")
+            checkpoint_path = None
+            resume_path = None
         if resume_path:
             if not resume_path.endswith(".npz"):
                 resume_path += ".npz"
@@ -851,9 +903,11 @@ class _Frontier:
             status = packed[13:13 + self.n_lanes].astype(np.int32)
             fork_cond = packed[13 + self.n_lanes:
                                13 + 2 * self.n_lanes].astype(np.int32)
+            lane_ctx = packed[13 + 2 * self.n_lanes:
+                              13 + 3 * self.n_lanes].astype(np.int32)
             if sched.telemetry is not None:
                 self._publish_telemetry(
-                    packed[13 + 2 * self.n_lanes:],
+                    packed[13 + 3 * self.n_lanes:],
                     running=int(np.sum(status == RUNNING)),
                     stack_top=stack_top, esc_count=esc_count,
                     arena_n=arena_n)
@@ -862,6 +916,12 @@ class _Frontier:
             self.stack_pushes = push_base + pushes
             self.stack_pops = pop_base + pops
             dirty = False  # host mutated lane state this round?
+            # per-contract deadline drain: a fleet member past its budget
+            # has its live lanes killed in place — freed for reseeding by
+            # the surviving contracts, NOT a global abort
+            if self.fleet is not None \
+                    and self.fleet.deadline_drain(self, status, lane_ctx):
+                dirty = True
             # cold-SLOAD pauses need a host fault-in to progress at all
             cold = np.nonzero((status == FORKING) & (fork_cond == 0))[0]
             if len(cold):
@@ -997,8 +1057,11 @@ class _Frontier:
         ec_d = delta[n_op + n_lc:n_op + n_lc + n_ec]
         occupancy = tel_words[n_op + n_lc + n_ec:n_op + n_lc + n_ec + 2]
         hwm = tel_words[n_op + n_lc + n_ec + 2:n_op + n_lc + n_ec + 4]
-        tag_d = delta[n_op + n_lc + n_ec + 4:]
+        tag_base = n_op + n_lc + n_ec + 4
+        tag_d = delta[tag_base:tag_base + len(self.tag_names)]
+        fleet_d = delta[tag_base + len(self.tag_names):]
         self._last_tag_delta = tag_d  # merge-pass trigger signal
+        self._last_fleet_delta = fleet_d
 
         metrics.inc("frontier.telemetry.executed", int(np.sum(op_d)))
         metrics.inc("frontier.telemetry.forks",
@@ -1050,6 +1113,15 @@ class _Frontier:
             if count:
                 metrics.observe("frontier.telemetry.tag_occupancy",
                                 int(count), label=name)
+        # per-contract fleet occupancy (running-lane-steps this chunk per
+        # packed contract) — the fairness signal frontierview renders
+        if self.fleet_names:
+            metrics.set_gauge("frontier.fleet.contracts",
+                              len(self.fleet_names))
+            for name, count in zip(self.fleet_names, fleet_d):
+                if count:
+                    metrics.observe("frontier.fleet.lane_steps",
+                                    int(count), label=name)
         if slog.enabled():
             # correlated structured log line per chunk: under serve the
             # handling thread's contextvar carries the request's cid
@@ -1076,6 +1148,10 @@ class _Frontier:
                 trace.counter("frontier.tags", **{
                     name: int(count)
                     for name, count in zip(self.tag_names, tag_d)})
+            if self.fleet_names:
+                trace.counter("frontier.fleet", **{
+                    name: int(count)
+                    for name, count in zip(self.fleet_names, fleet_d)})
 
     @staticmethod
     def _discard_checkpoint(checkpoint_path) -> None:
@@ -1496,29 +1572,47 @@ class _Frontier:
         from ..core.state.constraints import Constraints
         from ..support.model import prefetch_models
 
-        sets = []
+        # fleet mode: group rows per owning member so each group's queries
+        # build under that member's keccak axioms / symbol namespace and
+        # carry its contract id as the dispatch query origin. Every group
+        # still lands on the SAME dispatch queue before any flush — mixed
+        # fleets produce genuinely shared solver batches.
+        groups: List[Tuple[object, list]] = []
+        by_member: Dict[int, list] = {}
         for row in rows:
             if int(planes_np["cond_count"][row]) <= 0:
                 continue
             ctx = self.contexts[int(planes_np["ctx_id"][row])]
+            member = getattr(ctx, "member", None)
+            if member is not None and member.abandoned:
+                continue  # deadline-drained: its rows never materialize
             if state_np is not None and cfa_screen.statically_dead(
                     ctx.template.environment.code,
                     int(state_np["pc"][row])):
                 metrics.inc("cfa.frontier.prefetch_skipped")
                 continue
-            constraints = Constraints(
-                list(ctx.template.world_state.constraints)
-                + self._cond_bools(planes_np, self.harena, row))
-            sets.append(tuple(constraints.get_all_constraints()))
-        if not sets:
-            return
-        try:
-            prefetch_models(sets)
-        except (KeyboardInterrupt, SystemExit):
-            raise
-        except Exception as error:
-            log.debug("feasibility prefetch failed (%r) — rows solve "
-                      "individually", error)
+            key = id(member)
+            if key not in by_member:
+                by_member[key] = []
+                groups.append((member, by_member[key]))
+            by_member[key].append((ctx, row))
+        for member, group_rows in groups:
+            sets = []
+            with _member_env(self.fleet, member):
+                for ctx, row in group_rows:
+                    constraints = Constraints(
+                        list(ctx.template.world_state.constraints)
+                        + self._cond_bools(planes_np, self.harena, row))
+                    sets.append(tuple(constraints.get_all_constraints()))
+                if not sets:
+                    continue
+                try:
+                    prefetch_models(sets)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as error:
+                    log.debug("feasibility prefetch failed (%r) — rows "
+                              "solve individually", error)
 
     def _feasible(self, planes_np, harena, lane: int) -> bool:
         from ..core.state.constraints import Constraints
@@ -1526,26 +1620,34 @@ class _Frontier:
         from ..support.model import get_model
 
         ctx = self.contexts[int(planes_np["ctx_id"][lane])]
-        constraints = Constraints(
-            list(ctx.template.world_state.constraints)
-            + self._cond_bools(planes_np, harena, lane))
-        try:
-            get_model(tuple(constraints.get_all_constraints()))
-            return True
-        except SolverTimeOutException:
-            # budget exhaustion is NOT infeasibility (it subclasses
-            # UnsatError): keep the lane, the host re-checks at issue time
-            return True
-        except UnsatError:
-            return False
-        except Exception:
-            return True  # any other solver trouble: keep exploring
+        with _member_env(self.fleet, getattr(ctx, "member", None)):
+            constraints = Constraints(
+                list(ctx.template.world_state.constraints)
+                + self._cond_bools(planes_np, harena, lane))
+            try:
+                get_model(tuple(constraints.get_all_constraints()))
+                return True
+            except SolverTimeOutException:
+                # budget exhaustion is NOT infeasibility (it subclasses
+                # UnsatError): keep the lane, the host re-checks at issue
+                # time
+                return True
+            except UnsatError:
+                return False
+            except Exception:
+                return True  # any other solver trouble: keep exploring
 
     # -- materialization ---------------------------------------------------------------
 
     def _materialize_np(self, state_np, planes_np, harena, lane: int):
 
         ctx = self.contexts[int(planes_np["ctx_id"][lane])]
+        member = getattr(ctx, "member", None)
+        if member is not None and member.abandoned:
+            # the owning contract hit its budget: its escaped rows drop
+            # exactly like the host's mid-worklist states on timeout
+            member.count_dropped(1)
+            return
         # OPTIMISTIC by default, matching the host engine's JUMPI exactly
         # (core/instructions.py jumpi_ forks both sides with no solver call;
         # the reference does the same — feasibility is decided at issue
@@ -1678,10 +1780,13 @@ class _Frontier:
             mstate.depth += int(planes_np["cond_count"][lane])
 
         self.materialized += 1
-        if getattr(self.laser, "requires_statespace", False) and \
+        # fleet demux: rows re-enter their OWN contract's engine worklist,
+        # not the frontier owner's — detections stay per-contract
+        laser = getattr(ctx, "laser", None) or self.laser
+        if getattr(laser, "requires_statespace", False) and \
                 global_state.node is None:
             global_state.node = template.node
-        self.laser.work_list.append(global_state)
+        laser.work_list.append(global_state)
 
     # -- checkpointing -----------------------------------------------------------------
 
@@ -1754,6 +1859,12 @@ class _Frontier:
                 dtype=np.uint8)
         finally:
             sys_module.setrecursionlimit(limit)
+        # per-context contract namespace: a killed FLEET run must resume
+        # per-contract — lane counts alone would let contract A's wave
+        # graft onto contract B's fresh seeding (same lane/context shape)
+        arrays["contract_ids"] = np.frombuffer(
+            pickle.dumps([_ctx_contract_id(ctx) for ctx in self.contexts]),
+            dtype=np.uint8)
         from ..support.checkpoint import fsync_replace
 
         import time as time_module
@@ -1790,6 +1901,15 @@ class _Frontier:
                 raise ValueError(
                     f"checkpoint is for transaction {saved_tx}, the "
                     f"analysis is at transaction {current_tx}")
+        if "contract_ids" in data:
+            import pickle
+
+            saved_ids = pickle.loads(data["contract_ids"].tobytes())
+            current_ids = [_ctx_contract_id(ctx) for ctx in self.contexts]
+            if saved_ids != current_ids:
+                raise ValueError(
+                    f"checkpoint contract namespace mismatch: saved "
+                    f"{saved_ids}, this seeding has {current_ids}")
         if "host_terms" in data:
             import pickle
 
@@ -1856,9 +1976,12 @@ class _Frontier:
                      len(live), backlog)
             # graceful-drain accounting: the partial report's coverage
             # stats count these alongside the host's own dropped states
-            self.laser.timed_out = True
-            self.laser.dropped_states = getattr(
-                self.laser, "dropped_states", 0) + len(live) + backlog
+            if self.fleet is not None:
+                self._drop_fleet_lanes(planes, sched, live)
+            else:
+                self.laser.timed_out = True
+                self.laser.dropped_states = getattr(
+                    self.laser, "dropped_states", 0) + len(live) + backlog
             return
         if not len(live) and not backlog:
             return
@@ -1884,15 +2007,39 @@ class _Frontier:
                 1, 0])
         del self.pending[:]
 
+    def _drop_fleet_lanes(self, planes, sched, live) -> None:
+        """Global-budget exhaustion in fleet mode: attribute every dropped
+        lane / backlog row to the contract that owned it, so each member's
+        partial report carries ITS dropped-state count (host-timeout
+        parity per contract, not a pooled number on the primary)."""
+        ctx_ids = [int(c) for c in np.asarray(planes.ctx_id)[live]]
+        if sched is not None:
+            stack_ids = np.asarray(sched.stack_planes.ctx_id)
+            esc_ids = np.asarray(sched.esc_planes.ctx_id)
+            ctx_ids += [int(c) for c in stack_ids[:int(sched.stack_top)]]
+            ctx_ids += [int(c) for c in esc_ids[:int(sched.esc_count)]]
+        for _, row_planes in self.pending:
+            ctx_ids.append(int(np.asarray(row_planes["ctx_id"]).flat[0]))
+        for cid in ctx_ids:
+            ctx = self.contexts[cid] if 0 <= cid < len(self.contexts) \
+                else None
+            member = getattr(ctx, "member", None) if ctx else None
+            if member is not None:
+                member.count_dropped(1)
+            else:
+                self.laser.timed_out = True
+                self.laser.dropped_states = getattr(
+                    self.laser, "dropped_states", 0) + 1
 
-def execute_message_call_tpu(laser_evm, callee_address,
-                             func_hashes=None) -> None:
-    """Drop-in for core/transaction/symbolic.py execute_message_call: seed the
-    device frontier from every open state, explore, and drain the escaped
-    states through the host engine (detectors run there unchanged).
-    `func_hashes` restricts the tx's 4-byte selector exactly as on the host
-    path (generate_function_constraints) so `--transaction-sequences` and the
-    tx prioritizer behave identically under both engines."""
+
+def build_seed_templates(laser_evm, callee_address,
+                         func_hashes=None) -> List[GlobalState]:
+    """Consume the laser's open states into frontier seed templates — one
+    pending MessageCallTransaction GlobalState per open world state, with
+    the ACTORS caller constraint and the 4-byte selector restriction
+    applied exactly as on the host path. Shared by the solo device path
+    (execute_message_call_tpu) and the fleet gate, so both seed
+    identically."""
     from ..core.transaction.symbolic import (ACTORS,
                                              generate_function_constraints)
     from ..core.state.calldata import SymbolicCalldata
@@ -1935,6 +2082,18 @@ def execute_message_call_tpu(laser_evm, callee_address,
         if getattr(laser_evm, "requires_statespace", False):
             laser_evm.new_node_for_transaction(template, transaction)
         seeds.append(template)
+    return seeds
+
+
+def execute_message_call_tpu(laser_evm, callee_address,
+                             func_hashes=None) -> None:
+    """Drop-in for core/transaction/symbolic.py execute_message_call: seed the
+    device frontier from every open state, explore, and drain the escaped
+    states through the host engine (detectors run there unchanged).
+    `func_hashes` restricts the tx's 4-byte selector exactly as on the host
+    path (generate_function_constraints) so `--transaction-sequences` and the
+    tx prioritizer behave identically under both engines."""
+    seeds = build_seed_templates(laser_evm, callee_address, func_hashes)
 
     if not seeds:
         laser_evm.exec()
@@ -1989,3 +2148,413 @@ def execute_message_call_tpu(laser_evm, callee_address,
             laser_evm.dropped_states = getattr(
                 laser_evm, "dropped_states", 0) + dropped
             del frontier.deferred[:]
+
+
+# -- fleet packing ---------------------------------------------------------------------
+#
+# FleetDriver runs N independent contract analyses as ONE device workload:
+# every member's per-transaction seeds land in a single shared _Frontier
+# (per-lane ctx_id keeps ownership; merge_pass already refuses cross-ctx
+# pairs), the fused stepper runs once for everyone, and escaped rows demux
+# back into each member's OWN engine worklist. Host turns stay strictly
+# serialized — one member holds the token at a time, and the process-global
+# singletons the engine leans on (tx id counter, keccak axioms, detector
+# issue/cache state) are SWAPPED per turn so every member sees exactly the
+# namespace a solo run would: detections come out byte-identical to N
+# sequential runs, while the device and the solver dispatch queue see the
+# union of everyone's work.
+
+
+def _ctx_contract_id(ctx) -> str:
+    """Stable contract namespace for a seeding context (checkpoint
+    validation): the owning fleet member's id, else the contract name."""
+    member = getattr(ctx, "member", None)
+    if member is not None:
+        return member.contract_id
+    account = ctx.template.environment.active_account
+    return getattr(account, "contract_name", "") or ""
+
+
+@contextmanager
+def _member_env(fleet, member):
+    """Solver-side view swap: run the body under `member`'s symbol
+    namespace (tx id counter + keccak axioms) with its contract id as the
+    dispatch query origin. No-op outside fleet mode."""
+    if fleet is None or member is None:
+        yield
+        return
+    with fleet.member_env(member):
+        yield
+
+
+class FleetMember:
+    """One contract's analysis job inside a fleet."""
+
+    def __init__(self, index: int, contract_id: str, work=None,
+                 execution_timeout: int = 0):
+        self.index = index
+        self.contract_id = contract_id
+        #: the whole per-contract analysis (SymExecWrapper + detector
+        #: harvest), supplied by the analyzer; runs on this member's thread
+        self.work = work
+        self.execution_timeout = execution_timeout
+        self.driver: Optional["FleetDriver"] = None
+        self.laser = None        # set by SymExecWrapper(fleet=member)
+        self.gate_laser = None   # laser parked at the device gate
+        self.gate_seeds: Optional[List[GlobalState]] = None
+        self.result = None       # work()'s return (the member's issues)
+        self.error: Optional[BaseException] = None
+        self.traceback_str = ""
+        self.done = False
+        #: deadline-drained on device: lanes freed, rows skipped+counted
+        self.abandoned = False
+        self._pending_feeder = None
+        self.thread: Optional[threading.Thread] = None
+        self._grant = threading.Event()
+        self._yield = threading.Event()
+        # per-member snapshots of the process-global singletons (installed
+        # by FleetDriver._swap_in, captured back by _swap_out)
+        self.tx_counter = 0
+        self.keccak_state: Dict[str, object] = {}
+        self.module_state: Dict[str, Dict[str, object]] = {}
+
+    def install(self, laser_evm) -> None:
+        """Attach this member to its freshly-built laser (called from
+        SymExecWrapper construction on the member's thread)."""
+        self.laser = laser_evm
+        laser_evm.contract_id = self.contract_id
+        laser_evm.fleet_gate = self._gate
+
+    def _gate(self, laser_evm, callee_address, func_hashes=None) -> None:
+        self.driver.gate(self, laser_evm, callee_address, func_hashes)
+
+    def budget_remaining(self) -> float:
+        """Seconds left in this member's own execution budget (inf when
+        untimed). Mirrors svm._exec_pass: total wall since the member's
+        transaction phase began."""
+        laser = self.gate_laser or self.laser
+        timeout = getattr(laser, "execution_timeout", 0) if laser \
+            else self.execution_timeout
+        if not timeout:
+            return float("inf")
+        started = getattr(laser, "time", None)
+        if started is None:
+            return float(timeout)
+        from datetime import datetime
+
+        return timeout - (datetime.now() - started).total_seconds()
+
+    def count_dropped(self, n: int) -> None:
+        """Host-timeout parity accounting: `n` of this member's states
+        were dropped (deadline drain / skipped materialization)."""
+        laser = self.gate_laser or self.laser
+        if laser is None or not n:
+            return
+        laser.timed_out = True
+        laser.dropped_states = getattr(laser, "dropped_states", 0) + n
+
+
+class FleetDriver:
+    """Seed, step, merge, and drain N contracts in one jit program.
+
+    Protocol: every member runs its UNCHANGED engine loop on its own
+    thread, but only one thread holds the execution token at a time. A
+    member's turn ends when it parks at the device gate (seeds built for
+    its next transaction) or finishes. When every live member is parked,
+    the coordinator packs all parked seeds into one _Frontier, runs the
+    device phase once, then hands each member a shared feeder and resumes
+    the turns. A member that exhausts its budget mid-phase is deadline-
+    drained on device — its lanes free for the others, its report comes
+    out `incomplete` — never a global abort."""
+
+    def __init__(self, members: List[FleetMember], modules=None):
+        self.members = members
+        for member in members:
+            member.driver = self
+        self.modules = modules
+        self.aborted = False
+        self.frontier: Optional[_Frontier] = None
+        #: cumulative device counters across phases (bench/logs)
+        self.lane_steps = 0
+        self.forks = 0
+        self.phases = 0
+        self._active: Optional[FleetMember] = None
+        self._all_modules = None
+
+    # -- singleton swap ----------------------------------------------------------------
+
+    def _module_list(self):
+        if self._all_modules is None:
+            from ..analysis.module import ModuleLoader
+            from ..analysis.module.base import EntryPoint
+
+            loader = ModuleLoader()
+            self._all_modules = (
+                loader.get_detection_modules(entry_point=EntryPoint.CALLBACK)
+                + loader.get_detection_modules(entry_point=EntryPoint.POST))
+        return self._all_modules
+
+    def _swap_in(self, member: FleetMember) -> None:
+        """Install `member`'s view of the process-global singletons: the
+        tx id counter, the keccak function manager, every detection
+        module's issues + dedup cache, and the dispatch query origin. Each
+        member's snapshots descend from a FRESH reset, so symbol names and
+        issue caches match a solo run of that contract exactly."""
+        from ..core.function_managers import keccak_function_manager
+        from ..core.transaction.transaction_models import tx_id_manager
+        from ..smt.solver import dispatch
+
+        tx_id_manager.set_counter(member.tx_counter)
+        if not member.keccak_state:
+            fresh = type(keccak_function_manager)()
+            member.keccak_state = dict(fresh.__dict__)
+        keccak_function_manager.__dict__.clear()
+        keccak_function_manager.__dict__.update(member.keccak_state)
+        for module in self._module_list():
+            saved = member.module_state.setdefault(
+                module.name, {"issues": [], "cache": set()})
+            module.issues = saved["issues"]
+            module.cache = saved["cache"]
+        dispatch.set_query_origin(member.contract_id)
+        self._active = member
+
+    def _swap_out(self, member: FleetMember) -> None:
+        from ..core.function_managers import keccak_function_manager
+        from ..core.transaction.transaction_models import tx_id_manager
+        from ..smt.solver import dispatch
+
+        member.tx_counter = tx_id_manager._next_transaction_id
+        member.keccak_state = dict(keccak_function_manager.__dict__)
+        for module in self._module_list():
+            member.module_state[module.name] = {
+                "issues": module.issues, "cache": module.cache}
+        dispatch.set_query_origin(None)
+        self._active = None
+
+    @contextmanager
+    def member_env(self, member: FleetMember):
+        """Temporary solver-side swap (feasibility checks and prefetch
+        batches during a device phase): `member`'s symbol namespace and
+        query origin, restored on exit. A no-op when the member already
+        holds the token — its LIVE singleton state must not be clobbered
+        by its own stale snapshot."""
+        if member is self._active:
+            yield
+            return
+        from ..core.function_managers import keccak_function_manager
+        from ..core.transaction.transaction_models import tx_id_manager
+        from ..smt.solver import dispatch
+
+        saved_tx = tx_id_manager._next_transaction_id
+        saved_keccak = dict(keccak_function_manager.__dict__)
+        saved_origin = dispatch.get_query_origin()
+        tx_id_manager.set_counter(member.tx_counter)
+        if not member.keccak_state:
+            fresh = type(keccak_function_manager)()
+            member.keccak_state = dict(fresh.__dict__)
+        keccak_function_manager.__dict__.clear()
+        keccak_function_manager.__dict__.update(member.keccak_state)
+        dispatch.set_query_origin(member.contract_id)
+        try:
+            yield
+        finally:
+            member.tx_counter = tx_id_manager._next_transaction_id
+            member.keccak_state = dict(keccak_function_manager.__dict__)
+            keccak_function_manager.__dict__.clear()
+            keccak_function_manager.__dict__.update(saved_keccak)
+            tx_id_manager.set_counter(saved_tx)
+            dispatch.set_query_origin(saved_origin)
+
+    # -- token / clock -----------------------------------------------------------------
+
+    def _arm_clock(self, seconds: float) -> None:
+        from ..core.time_handler import time_handler
+
+        if seconds == float("inf"):
+            time_handler.reset()
+        else:
+            time_handler.start_execution(max(int(seconds), 1))
+
+    def _run_turn(self, member: FleetMember) -> None:
+        """Grant the token: the member runs until its next gate park or
+        completion. The global clock is re-armed with ITS remaining
+        budget first (the member re-arms itself at each transaction-phase
+        start, exactly like a solo run)."""
+        self._swap_in(member)
+        self._arm_clock(member.budget_remaining())
+        member._yield.clear()
+        member._grant.set()
+        member._yield.wait()
+        self._swap_out(member)
+
+    # -- member-thread side ------------------------------------------------------------
+
+    def _member_main(self, member: FleetMember) -> None:
+        member._grant.wait()
+        member._grant.clear()
+        try:
+            member.result = member.work()
+        except BaseException as error:  # noqa: BLE001 — reported per member
+            member.error = error
+            member.traceback_str = traceback.format_exc()
+            log.warning("fleet member %r failed: %r", member.contract_id,
+                        error)
+        finally:
+            member.done = True
+            member._yield.set()
+
+    def gate(self, member: FleetMember, laser_evm, callee_address,
+             func_hashes=None) -> None:
+        """The per-transaction device gate (replaces
+        execute_message_call_tpu for fleet members): build this member's
+        seeds, park until the coordinator has run the shared device phase,
+        then drain the shared feeder through this member's own exec loop."""
+        seeds = build_seed_templates(laser_evm, callee_address, func_hashes)
+        if not seeds:
+            laser_evm.exec()
+            return
+        member.gate_seeds = seeds
+        member.gate_laser = laser_evm
+        member._yield.set()
+        member._grant.wait()
+        member._grant.clear()
+        if self.aborted:
+            raise RuntimeError("fleet driver aborted")
+        member.gate_seeds = None
+        feeder = member._pending_feeder
+        member._pending_feeder = None
+        laser_evm.frontier_feeder = feeder
+        try:
+            with trace.span("frontier.host_continuation"):
+                laser_evm.exec()
+        finally:
+            laser_evm.frontier_feeder = None
+
+    # -- coordinator -------------------------------------------------------------------
+
+    def run(self) -> List[FleetMember]:
+        for member in self.members:
+            member.thread = threading.Thread(
+                target=self._member_main, args=(member,),
+                name=f"fleet-{member.index}", daemon=True)
+            member.thread.start()
+        try:
+            # first turns: construction + creation tx, up to the first gate
+            for member in self.members:
+                if not member.done:
+                    self._run_turn(member)
+            while True:
+                gated = [m for m in self.members
+                         if not m.done and m.gate_seeds is not None]
+                if not gated:
+                    break
+                self._device_phase(gated)
+                for member in gated:
+                    if not member.done:
+                        self._run_turn(member)
+        except BaseException:
+            self.aborted = True
+            for member in self.members:
+                member._grant.set()  # release parked threads to fail out
+            raise
+        finally:
+            self._drain_frontier()
+            from ..core.time_handler import time_handler
+
+            time_handler.reset()
+            for member in self.members:
+                if member.thread is not None:
+                    member.thread.join(timeout=60)
+        return self.members
+
+    def _drain_frontier(self) -> None:
+        """Materialize every row still deferred on the previous phase's
+        frontier into its owner's worklist (abandoned members' rows are
+        skipped and counted): a member's exec turn that timed out must not
+        strand OTHER members' rows."""
+        frontier, self.frontier = self.frontier, None
+        if frontier is None:
+            return
+        try:
+            feeder = frontier.make_feeder(batch_rows=1024)
+            while feeder():
+                pass
+        except Exception as error:  # noqa: BLE001
+            log.warning("fleet: draining leftover deferred rows failed "
+                        "(%r)", error)
+
+    def _device_phase(self, gated: List[FleetMember]) -> None:
+        """Pack every parked member's seeds into ONE frontier and run the
+        fused device loop once for all of them."""
+        self._drain_frontier()
+        seeds: List[GlobalState] = []
+        owners: List[FleetMember] = []
+        for member in gated:
+            seeds.extend(member.gate_seeds)
+            owners.extend([member] * len(member.gate_seeds))
+        primary = gated[0].gate_laser
+        lane_budget = tpu_config.get_int("MYTHRIL_TPU_FLEET_LANES", 0) \
+            or tpu_config.get_int("MYTHRIL_TPU_LANES", DEFAULT_LANES)
+        frontier = _Frontier(primary, n_lanes=max(lane_budget,
+                                                  2 * len(seeds)))
+        frontier.fleet = self
+        with trace.span("frontier.fleet.seed", seeds=len(seeds),
+                        contracts=len(gated)):
+            state, planes = frontier.seed(seeds)
+        ctx_of: Dict[int, List[int]] = {}
+        for index, (ctx, owner) in enumerate(zip(frontier.contexts,
+                                                 owners)):
+            ctx.member = owner
+            ctx.laser = owner.gate_laser
+            ctx_of.setdefault(id(owner), []).append(index)
+        frontier._fleet_ctx_of = ctx_of
+        self._arm_clock(max(m.budget_remaining() for m in gated))
+        self.phases += 1
+        metrics.inc("frontier.fleet.phases")
+        if slog.enabled():
+            slog.event("fleet.phase", contracts=len(gated),
+                       seeds=len(seeds), lanes=frontier.n_lanes)
+        with trace.span("frontier.fleet.device_phase",
+                        lanes=frontier.n_lanes,
+                        contracts=len(gated)) as phase:
+            frontier.run(state, planes)
+            phase.set(forks=frontier.forks, lane_steps=frontier.lane_steps)
+        self.lane_steps += frontier.lane_steps
+        self.forks += frontier.forks
+        self.frontier = frontier
+        feeder = frontier.make_feeder()
+        for member in gated:
+            member._pending_feeder = feeder
+
+    def deadline_drain(self, frontier: "_Frontier", status: np.ndarray,
+                       lane_ctx: np.ndarray) -> bool:
+        """Per-contract deadline drain, called once per chunk from the
+        frontier loop: members past their budget have their live lanes
+        killed IN PLACE (freed for reseeding by the others) and every
+        dropped lane counted on their own laser. Returns True when lane
+        state changed (the caller re-uploads)."""
+        changed = False
+        live = ((status == RUNNING) | (status == FORKING)
+                | (status == ESCAPED))
+        ctx_of = getattr(frontier, "_fleet_ctx_of", {})
+        for member in self.members:
+            if not member.abandoned:
+                if member.budget_remaining() > 1.0:
+                    continue
+                member.abandoned = True
+                log.info("fleet member %r exhausted its budget; draining "
+                         "its lanes (others continue)", member.contract_id)
+                if slog.enabled():
+                    slog.event("fleet.deadline_drain",
+                               contract=member.contract_id)
+            indices = ctx_of.get(id(member))
+            if not indices:
+                continue
+            mask = live & np.isin(lane_ctx, indices)
+            count = int(np.sum(mask))
+            if count:
+                status[mask] = DEAD
+                member.count_dropped(count)
+                metrics.inc("frontier.fleet.drained", count)
+                changed = True
+        return changed

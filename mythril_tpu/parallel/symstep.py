@@ -140,17 +140,24 @@ class Telemetry(NamedTuple):
     hwm: jnp.ndarray        # i64[2] — (stack_top high-water, esc_count hw)
     tag_pcs: jnp.ndarray    # i32[K] static merge/loop-header pcs (-1 empty)
     tag_occ: jnp.ndarray    # i64[K] running-lane-steps at each tagged pc
+    fleet_slots: jnp.ndarray  # i32[C] static seeding-context -> fleet slot
+    fleet_occ: jnp.ndarray    # i64[F] running-lane-steps per fleet slot
 
 
 #: summary words contributed before the variable-length tag_occ block
 TELEMETRY_FIXED_WORDS = N_OP_CLASSES + N_LIFECYCLE + N_ESC_CAUSES + 2 + 2
 
 
-def new_telemetry(tag_pcs=None) -> Telemetry:
+def new_telemetry(tag_pcs=None, fleet_slots=None, n_fleet=0) -> Telemetry:
     """Zeroed counter plane. `tag_pcs` is a host-side int sequence of
-    merge-point / loop-header byte addresses to track occupancy at."""
+    merge-point / loop-header byte addresses to track occupancy at.
+    `fleet_slots` maps each seeding-context index to one of `n_fleet`
+    fleet slots (one slot per packed contract); when omitted the fleet
+    occupancy block is empty and contributes no summary words."""
     pcs = np.asarray([] if tag_pcs is None else list(tag_pcs),
                      dtype=np.int32)
+    slots = np.asarray([] if fleet_slots is None else list(fleet_slots),
+                       dtype=np.int32)
     i64 = jnp.int64
     return Telemetry(
         op_hist=jnp.zeros(N_OP_CLASSES, dtype=i64),
@@ -160,15 +167,19 @@ def new_telemetry(tag_pcs=None) -> Telemetry:
         hwm=jnp.zeros(2, dtype=i64),
         tag_pcs=jnp.asarray(pcs),
         tag_occ=jnp.zeros(pcs.shape[0], dtype=i64),
+        fleet_slots=jnp.asarray(slots),
+        fleet_occ=jnp.zeros(int(n_fleet), dtype=i64),
     )
 
 
 def telemetry_words(tel: Telemetry) -> jnp.ndarray:
     """Flatten the counters into the i64 vector appended to the per-chunk
     summary (layout: op_hist | lifecycle | esc_cause | occupancy | hwm |
-    tag_occ; tag_pcs is static and never downloaded)."""
+    tag_occ | fleet_occ; tag_pcs / fleet_slots are static and never
+    downloaded)."""
     return jnp.concatenate([tel.op_hist, tel.lifecycle, tel.esc_cause,
-                            tel.occupancy, tel.hwm, tel.tag_occ])
+                            tel.occupancy, tel.hwm, tel.tag_occ,
+                            tel.fleet_occ])
 
 
 class SymPlanes(NamedTuple):
@@ -803,10 +814,22 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
                 axis=0, dtype=jnp.int64)
         else:
             tag_occ = tel.tag_occ
+        # per-contract fleet occupancy: running lanes bucketed by the
+        # fleet slot their seeding context belongs to (scatter-add with
+        # out-of-range drop, same shape as the op_hist accumulation)
+        if tel.fleet_occ.shape[0]:
+            n_ctx = tel.fleet_slots.shape[0]
+            lane_slot = tel.fleet_slots[
+                jnp.clip(planes.ctx_id, 0, n_ctx - 1)]
+            fleet_occ = tel.fleet_occ.at[
+                jnp.where(running, lane_slot, tel.fleet_occ.shape[0])].add(
+                one, mode="drop")
+        else:
+            fleet_occ = tel.fleet_occ
         sched = sched._replace(telemetry=tel._replace(
             op_hist=op_hist, lifecycle=tel.lifecycle + lc_deltas,
             esc_cause=esc_cause, occupancy=occupancy, hwm=hwm,
-            tag_occ=tag_occ))
+            tag_occ=tag_occ, fleet_occ=fleet_occ))
 
     return new_state, new_planes, arena, sched
 

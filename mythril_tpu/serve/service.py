@@ -59,15 +59,165 @@ class _RequestArgs:
     every field with a default, so only overrides need to exist)."""
 
 
+class _FleetTicket:
+    """One analyze request waiting on (or leading) a fleet micro-batch."""
+
+    def __init__(self, params: Dict, cid: str):
+        self.params = params
+        self.cid = cid
+        self.done = threading.Event()
+        self.payload: Optional[Dict] = None
+        self.error: Optional[BaseException] = None
+
+
+class _FleetBatcher:
+    """Micro-batching admission for `analyze` (opt-in: `serve --fleet` /
+    MYTHRIL_TPU_FLEET_SERVE).
+
+    Instead of queueing on the engine lock one-by-one, concurrent
+    compatible requests form a batch: the first arrival for a parameter
+    key becomes the LEADER, waits MYTHRIL_TPU_FLEET_WINDOW_MS for
+    followers, then runs every member's contract as ONE fleet
+    (MythrilAnalyzer.fleet_contract_results — one shared device frontier,
+    merged solver flushes) and demuxes per-contract results back into
+    per-request replies. Followers just park on their ticket. Requests
+    whose parameters differ (another key) lead their own batch."""
+
+    #: params that must agree for two requests to share one fleet step
+    _KEY_FIELDS = ("engine", "solver", "strategy", "max_depth",
+                   "transaction_count", "bin_runtime", "deadline_ms")
+
+    def __init__(self, service: "AnalysisService"):
+        self.service = service
+        self._lock = threading.Lock()
+        self._waiting: Dict[tuple, list] = {}
+
+    def _key(self, params: Dict) -> tuple:
+        key = [params.get(field) for field in self._KEY_FIELDS]
+        modules = params.get("modules")
+        key.append(tuple(modules) if modules else None)
+        return tuple(key)
+
+    def run(self, params: Dict, cid: str) -> Dict:
+        """Join (or lead) the micro-batch for this request's parameter
+        key; returns this request's own payload."""
+        window_s = max(
+            tpu_config.get_float("MYTHRIL_TPU_FLEET_WINDOW_MS"), 0.0) / 1000.0
+        max_batch = max(tpu_config.get_int("MYTHRIL_TPU_FLEET_MAX_BATCH"), 1)
+        key = self._key(params)
+        ticket = _FleetTicket(params, cid)
+        with self._lock:
+            group = self._waiting.get(key)
+            if group is not None and len(group) < max_batch:
+                group.append(ticket)
+                leader = False
+            else:
+                self._waiting[key] = [ticket]
+                leader = True
+        if leader:
+            if window_s:
+                time.sleep(window_s)
+            with self._lock:
+                group = self._waiting.pop(key)
+            with self.service._engine_lock:
+                self._run_batch(group)
+        ticket.done.wait()
+        if ticket.error is not None:
+            raise ticket.error
+        return ticket.payload
+
+    def _run_batch(self, group: list) -> None:
+        """Leader-side: run every ticket's contract as one fleet and
+        complete the tickets. Always completes every ticket (with an
+        error when the batch itself fails) — followers must never hang."""
+        try:
+            self._run_batch_inner(group)
+        except BaseException as error:  # noqa: BLE001 — demuxed per ticket
+            for ticket in group:
+                if not ticket.done.is_set():
+                    ticket.error = error
+                    ticket.done.set()
+            raise
+
+    def _run_batch_inner(self, group: list) -> None:
+        from ..analysis.report import Report
+        from ..analysis.security import reset_callback_modules
+        from ..mythril import MythrilAnalyzer, MythrilDisassembler
+        from ..smt.solver.solver import reset_solver_backend
+
+        if len(group) >= 2:
+            metrics.inc("serve.fleet.windows")
+            metrics.inc("serve.fleet.batched", len(group))
+            slog.event("serve.fleet.batch", requests=len(group))
+        # one isolation reset per BATCH (the batch is the unit of engine
+        # occupancy, exactly like one solo request on the legacy path)
+        reset_solver_backend(keep_verdicts=True)
+        reset_callback_modules()
+        params = group[0].params
+        cmd = _RequestArgs()
+        cmd.solver = params.get("solver") or self.service.solver
+        cmd.engine = params.get("engine") or self.service.engine
+        cmd.max_depth = params["max_depth"]
+        cmd.fleet = True
+        deadline_ms = params.get("deadline_ms")
+        if deadline_ms:
+            cmd.execution_timeout = max(deadline_ms / 1000.0, 0.001)
+        else:
+            cmd.execution_timeout = 86400
+        disassembler = MythrilDisassembler()
+        address = None
+        live: list = []
+        for ticket in group:
+            try:
+                address, contract = disassembler.load_from_bytecode(
+                    ticket.params["code"], ticket.params["bin_runtime"])
+                self.service._seed_summary(contract)
+                live.append((ticket, contract))
+            except Exception as error:  # bad input fails ITS request only
+                ticket.error = error
+                ticket.done.set()
+        if not live:
+            return
+        analyzer = MythrilAnalyzer(
+            disassembler, cmd_args=cmd,
+            strategy=params.get("strategy") or self.service.strategy,
+            address=address)
+        results = analyzer.fleet_contract_results(
+            modules=params.get("modules"),
+            transaction_count=params["transaction_count"])
+        for (ticket, contract), entry in zip(live, results):
+            report = Report(contracts=[contract],
+                            exceptions=entry["exceptions"])
+            report.source = [getattr(contract, "input_file", contract.name)]
+            report.incomplete = entry["timed_out"]
+            report.coverage = entry["coverage"]
+            for issue in entry["issues"]:
+                report.append_issue(issue)
+            self.service._record_summary(contract)
+            ticket.payload = {
+                "issue_count": len(report.issues),
+                "incomplete": bool(report.incomplete),
+                "coverage": report.coverage or {},
+                "report": json.loads(report.as_json()),
+                "fleet_batched": len(results),
+            }
+            ticket.done.set()
+
+
 class AnalysisService:
     def __init__(self, solver: str = "cdcl", engine: str = "host",
                  strategy: str = "bfs",
                  manifest_path: Optional[str] = None,
                  warmup: Optional[bool] = None,
-                 max_inflight: Optional[int] = None):
+                 max_inflight: Optional[int] = None,
+                 fleet: Optional[bool] = None):
         self.solver = solver
         self.engine = engine
         self.strategy = strategy
+        if fleet is None:
+            fleet = tpu_config.get_flag("MYTHRIL_TPU_FLEET_SERVE")
+        self.fleet = bool(fleet)
+        self._fleet_batcher = _FleetBatcher(self) if self.fleet else None
         self.warmset = WarmSet(manifest_path)
         if warmup is None:
             warmup = tpu_config.get_flag("MYTHRIL_TPU_SERVE_WARMUP")
@@ -149,6 +299,13 @@ class AnalysisService:
             with slog.correlated(cid):
                 slog.event("serve.admitted", request_id=str(request.id),
                            op=request.op)
+                if self._fleet_batcher is not None and \
+                        (request.params.get("engine")
+                         or self.engine) == "tpu":
+                    # micro-batching path: the batch LEADER takes the
+                    # engine lock for the whole fleet step; followers
+                    # park on their ticket instead of queueing here
+                    return self._analyze(request, cid, fleet=True)
                 with self._engine_lock:
                     return self._analyze(request, cid)
         finally:
@@ -194,12 +351,13 @@ class AnalysisService:
             uptime_s=round(self.uptime_s(), 3),
             requests_served=self._requests_done,
             solver=self.solver, engine=self.engine,
+            fleet=self.fleet,
             max_inflight=self.max_inflight,
             warmset=self.warmset.status(),
             cached_verdicts=dispatch.cached_verdicts(),
             metrics=metrics.snapshot())
 
-    def _analyze(self, request, cid: str) -> Dict:
+    def _analyze(self, request, cid: str, fleet: bool = False) -> Dict:
         params = request.params
         started = time.monotonic()
         cold_before = metrics.value("xla.bucket_compiles")
@@ -208,7 +366,10 @@ class AnalysisService:
         with trace.span("serve.request", request_id=str(request.id),
                         correlation_id=cid) as span:
             try:
-                payload = self._run_analysis(params)
+                if fleet:
+                    payload = self._fleet_batcher.run(params, cid)
+                else:
+                    payload = self._run_analysis(params)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as error:

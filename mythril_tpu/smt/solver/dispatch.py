@@ -108,7 +108,7 @@ class _Entry:
     """One unique in-flight query (deduped submissions share it)."""
 
     __slots__ = ("key", "clauses", "n_vars", "max_conflicts", "created",
-                 "result")
+                 "result", "origins")
 
     def __init__(self, key: Optional[CanonicalKey], clauses: List[List[int]],
                  n_vars: int, max_conflicts: int):
@@ -118,6 +118,12 @@ class _Entry:
         self.max_conflicts = max_conflicts
         self.created = time.time()
         self.result: Optional[Verdict] = None
+        #: contract ids whose analyses submitted this query (fleet mode
+        #: tags the current origin per turn; dedup hits merge into it)
+        self.origins: set = set()
+        origin = get_query_origin()
+        if origin is not None:
+            self.origins.add(origin)
 
 
 class QueryFuture:
@@ -158,6 +164,9 @@ class DispatchQueue:
         self.pending: "OrderedDict[CanonicalKey, _Entry]" = OrderedDict()
         self.cache: "OrderedDict[CanonicalKey, Tuple[int, Optional[Tuple[bool, ...]]]]" \
             = OrderedDict()
+        #: flushes whose entries carried >= 2 distinct query origins
+        #: (diagnostic for fleet mode; survives reset())
+        self.shared_flushes = 0
 
     # -- cache -----------------------------------------------------------------------
 
@@ -196,6 +205,9 @@ class DispatchQueue:
         if entry is not None:
             statistics.batch_dedup_hits += 1
             entry.max_conflicts = max(entry.max_conflicts, max_conflicts)
+            origin = get_query_origin()
+            if origin is not None:
+                entry.origins.add(origin)
             return QueryFuture(queue=self, entry=entry)
         entry = _Entry(key, [list(lits) for lits in key[1]], n_vars,
                        max_conflicts)
@@ -267,11 +279,26 @@ class DispatchQueue:
             return
 
         statistics.device_queries += len(entries)
+        origins: set = set()
+        for entry in entries:
+            origins.update(entry.origins)
         if batched:
             statistics.batch_flushes += 1
             statistics.batch_flushed_queries += len(entries)
             metrics.observe("dispatch.flush.occupancy", len(entries))
+            if origins:
+                # fleet signal: how many contracts' queries share this
+                # launch (>= 2 means the batch is genuinely merged)
+                metrics.observe("dispatch.flush.contracts", len(origins))
+                if len(origins) >= 2:
+                    self.shared_flushes += 1
         max_steps = min(max(entry.max_conflicts for entry in entries), 50_000)
+        # MYTHRIL_TPU_DEVICE_CLAUSE_CAP (0 = the built-in per-device cap):
+        # CPU-backend gates shrink it so oversize queries answer UNKNOWN
+        # and fall back to native CDCL instead of grinding a host-emulated
+        # device solve — flush/occupancy accounting still runs either way
+        clause_cap = tpu_config.get_int("MYTHRIL_TPU_DEVICE_CLAUSE_CAP", 0) \
+            or jax_solver.DEFAULT_CLAUSE_CAP
         started = time.time()
         try:
             # the span covers exactly the device launch (the flush's device
@@ -282,12 +309,12 @@ class DispatchQueue:
                 if len(entries) == 1:
                     entry = entries[0]
                     results = [jax_solver.solve_cnf_device(
-                        entry.clauses, entry.n_vars, max_steps=max_steps)]
+                        entry.clauses, entry.n_vars, max_steps=max_steps,
+                        clause_cap=clause_cap)]
                 else:
                     results = jax_solver.solve_cnf_device_batch(
                         [(entry.clauses, entry.n_vars) for entry in entries],
-                        max_steps=max_steps,
-                        clause_cap=jax_solver.DEFAULT_CLAUSE_CAP)
+                        max_steps=max_steps, clause_cap=clause_cap)
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as error:  # classified: OOM / compile / crash
@@ -308,7 +335,7 @@ class DispatchQueue:
         if slog.enabled():
             # correlated flush record: cid rides the serve contextvar
             slog.event("dispatch.flush", occupancy=len(entries),
-                       batched=batched,
+                       batched=batched, contracts=len(origins),
                        latency_ms=round(elapsed * 1000.0, 3))
         # wall budget per AMORTIZED query, not per batch: N queries sharing
         # one launch legitimately take up to N x the per-query budget
@@ -361,6 +388,25 @@ class DispatchQueue:
 
 #: process-wide queue (solver.reset_solver_backend calls reset())
 _QUEUE = DispatchQueue()
+
+#: current query origin (a contract id): fleet mode tags every submission
+#: with the analysis that produced it, so flush records can report how many
+#: contracts shared one device launch. None outside fleet mode.
+_QUERY_ORIGIN: Optional[str] = None
+
+
+def set_query_origin(origin: Optional[str]) -> None:
+    global _QUERY_ORIGIN
+    _QUERY_ORIGIN = origin
+
+
+def get_query_origin() -> Optional[str]:
+    return _QUERY_ORIGIN
+
+
+def shared_flush_count() -> int:
+    """Flushes so far whose batch mixed queries from >= 2 contracts."""
+    return _QUEUE.shared_flushes
 
 
 def enabled() -> bool:

@@ -37,7 +37,7 @@ from ..observe import metrics, trace
 
 log = logging.getLogger(__name__)
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2  # v2: payloads are namespaced by contract id
 #: seconds between periodic mid-transaction saves
 SAVE_INTERVAL_S = 15.0
 #: states executed between periodic mid-transaction saves (overridable via
@@ -48,7 +48,8 @@ SAVE_INTERVAL_STATES = 2000
 #: truncated or foreign payload degrades to a fresh run instead of raising
 #: a KeyError deep inside resume
 REQUIRED_KEYS = ("version", "tx_index", "open_states", "work_list",
-                 "executed_nodes", "total_states", "detectors")
+                 "executed_nodes", "total_states", "detectors",
+                 "contract_id")
 
 
 def fsync_replace(tmp: str, path: str) -> None:
@@ -101,6 +102,7 @@ def save_host_checkpoint(path: str, laser, tx_index: int,
                          in_flight=None) -> None:
     payload = {
         "version": FORMAT_VERSION,
+        "contract_id": getattr(laser, "contract_id", ""),
         "tx_index": tx_index,
         "open_states": list(laser.open_states),
         "work_list": ([in_flight] if in_flight is not None else [])
@@ -127,9 +129,14 @@ def save_host_checkpoint(path: str, laser, tx_index: int,
                     (time.perf_counter() - started) * 1000.0)
 
 
-def load_host_checkpoint(path: str) -> Optional[dict]:
+def load_host_checkpoint(path: str,
+                         expected_contract_id: Optional[str] = None
+                         ) -> Optional[dict]:
     """Returns the payload, or None when the file is absent/corrupt/foreign
-    (a bad checkpoint must degrade to a fresh run, never crash the run)."""
+    (a bad checkpoint must degrade to a fresh run, never crash the run).
+
+    `expected_contract_id` guards fleet resumes: a checkpoint written for
+    another contract in the corpus must not restore into this one's laser."""
     if not os.path.exists(path):
         return None
     try:
@@ -148,6 +155,12 @@ def load_host_checkpoint(path: str) -> Optional[dict]:
         if missing:
             log.warning("checkpoint %s is missing required keys %s; ignoring",
                         path, missing)
+            return None
+        if expected_contract_id is not None and \
+                payload["contract_id"] != expected_contract_id:
+            log.warning(
+                "checkpoint %s belongs to contract %r, not %r; ignoring",
+                path, payload["contract_id"], expected_contract_id)
             return None
         return payload
     except Exception as error:

@@ -87,6 +87,11 @@ _KNOBS: List[Knob] = [
          "Max age (ms) a queued SAT query may wait before a flush."),
     Knob("MYTHRIL_TPU_VERDICT_CACHE", "int", 4096,
          "Entries in the canonical-CNF SAT/UNSAT verdict LRU cache."),
+    Knob("MYTHRIL_TPU_DEVICE_CLAUSE_CAP", "int", 0,
+         "Per-flush clause cap for device SAT solving; 0 uses the "
+         "built-in per-device cap. CPU-backend gates shrink it so "
+         "oversize queries fall back to native CDCL instead of grinding "
+         "a host-emulated device solve."),
     # -- resilience / failure domains --------------------------------------------
     Knob("MYTHRIL_TPU_BREAKER_TRIP", "int", 3,
          "Consecutive backend failures that trip the circuit breaker."),
@@ -108,6 +113,22 @@ _KNOBS: List[Knob] = [
          "~/.mythril_tpu)."),
     Knob("MYTHRIL_TPU_RPC", "str", None,
          "Default RPC endpoint preset for dynamic loading."),
+    # -- fleet packing (parallel/frontier.py FleetDriver) -------------------------
+    Knob("MYTHRIL_TPU_FLEET_LANES", "int", 0,
+         "Device lane count for fleet (multi-contract) frontiers; 0 "
+         "falls back to MYTHRIL_TPU_LANES."),
+    Knob("MYTHRIL_TPU_FLEET_WINDOW_MS", "float", 50.0,
+         "Micro-batching join window (ms): how long a serve fleet leader "
+         "waits for more compatible `analyze` requests before running "
+         "the shared fleet step."),
+    Knob("MYTHRIL_TPU_FLEET_MAX_BATCH", "int", 8,
+         "Max `analyze` requests packed into one serve fleet "
+         "micro-batch."),
+    Knob("MYTHRIL_TPU_FLEET_SERVE", "flag", False,
+         "Enable the serve micro-batching admission path (concurrent "
+         "compatible `analyze` requests join one fleet step instead of "
+         "queueing on the engine lock); `serve --fleet` sets the same "
+         "switch."),
     # -- analysis service (mythril_tpu/serve/) ------------------------------------
     Knob("MYTHRIL_TPU_SERVE_SOCKET", "str", None,
          "Unix-socket path for `myth-tpu serve` / `myth-tpu client` "

@@ -1,0 +1,219 @@
+"""Fleet mode (parallel/frontier.py FleetDriver): N contracts in one
+vmapped frontier with shared solver dispatch.
+
+The tentpole's contract is PARITY: packing contracts into one device job
+must not change any contract's detections — per-turn singleton swaps (tx
+id counter, keccak axioms, detector issue/cache state) give every member
+the exact namespace a solo run would see. These tests A/B a mini corpus
+through `--fleet` vs the sequential loop, exercise the per-contract
+deadline drain (a starved member reports incomplete while the others
+complete), and pin the checkpoint contract-id namespacing.
+
+The corpus is merge_smoke-sized (single-transaction shapes, native
+solver) so the whole A/B fits the tier-1 budget on CPU; the slow-marked
+corpus test scales the same A/B up.
+"""
+
+import pytest
+
+#: reconverging diamond ahead of an unprotected SELFDESTRUCT — SWC-106
+#: in one transaction (the tools/merge_smoke.py shape, re-declared here
+#: because importing that module mutates os.environ)
+BRANCHY = {
+    "boom()":
+        "PUSH1 0x00\nCALLDATALOAD\nPUSH1 0x01\nAND\n"
+        "PUSH @odd\nJUMPI\n"
+        "PUSH1 0x07\nPUSH @join\nJUMP\n"
+        "odd:\nJUMPDEST\nPUSH1 0x05\nJUMPDEST\n"
+        "join:\nJUMPDEST\nPUSH1 0x00\nSSTORE\nJUMPDEST\n"
+        "CALLER\nSELFDESTRUCT",
+}
+
+#: two symbolic calldata words ADDed and stored — SWC-101 in one
+#: transaction
+ADDFLOW_BODY = (
+    "PUSH1 0x04\nCALLDATALOAD\nPUSH1 0x24\nCALLDATALOAD\nADD\n"
+    "PUSH1 0x00\nSSTORE\n"
+    "PUSH1 0x01\nPUSH1 0x00\nMSTORE\nPUSH1 0x20\nPUSH1 0x00\nRETURN")
+
+ADDFLOW = {"bump()": ADDFLOW_BODY}
+
+#: both shapes behind one dispatcher — a member whose report must demux
+#: two different SWC classes from the same fleet
+COMBO = {"boom()": BRANCHY["boom()"], "bump()": ADDFLOW_BODY}
+
+MODULES = ["AccidentallyKillable", "IntegerArithmetics"]
+
+
+def _creation_hex(src) -> str:
+    from mythril_tpu.frontends.asm import (assemble, creation_wrapper,
+                                           dispatcher)
+
+    return creation_wrapper(assemble(dispatcher(src))).hex()
+
+
+def _fresh_engine():
+    from mythril_tpu.analysis.security import reset_callback_modules
+    from mythril_tpu.smt.solver.solver import reset_solver_backend
+
+    reset_solver_backend()
+    reset_callback_modules()
+
+
+def _analyze_corpus(corpus, fleet: bool, transaction_count: int = 1,
+                    execution_timeout: int = 240):
+    """Run `corpus` ([(name, creation_hex)]) through MythrilAnalyzer and
+    return {contract_name: sorted detection digests}."""
+    from mythril_tpu.mythril import MythrilAnalyzer, MythrilDisassembler
+
+    _fresh_engine()
+    disassembler = MythrilDisassembler()
+    address = None
+    for name, code in corpus:
+        address, contract = disassembler.load_from_bytecode(code, False)
+        contract.name = name
+
+    class Cmd:
+        pass
+
+    cmd = Cmd()
+    cmd.engine = "tpu"
+    cmd.fleet = fleet
+    cmd.execution_timeout = execution_timeout
+    cmd.create_timeout = 30
+    cmd.max_depth = 128
+    analyzer = MythrilAnalyzer(disassembler, cmd_args=cmd, strategy="bfs",
+                               address=address)
+    report = analyzer.fire_lasers(modules=MODULES,
+                                  transaction_count=transaction_count)
+    digests = {name: [] for name, _ in corpus}
+    for _, issue in sorted(report.issues.items()):
+        digests[issue.contract].append(
+            (issue.swc_id, issue.address, issue.function,
+             [step.get("input", "")[:10] for step in
+              issue.transaction_sequence["steps"]]))
+    for detections in digests.values():
+        detections.sort()
+    return digests
+
+
+@pytest.fixture(autouse=True)
+def _fleet_env(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_LANES", "16")
+
+
+def test_fleet_vs_sequential_parity_three_contracts():
+    """3-contract fleet A/B: byte-identical per-contract detections, and
+    the fleet telemetry (phases, per-contract lane-step counters) fired."""
+    from mythril_tpu.observe import metrics
+
+    corpus = [("branchy", _creation_hex(BRANCHY)),
+              ("addflow", _creation_hex(ADDFLOW)),
+              ("combo", _creation_hex(COMBO))]
+    sequential = _analyze_corpus(corpus, fleet=False)
+    assert any(sequential.values()), \
+        f"sequential baseline found no issues: {sequential}"
+
+    phases_before = metrics.value("frontier.fleet.phases")
+    metrics.reset("frontier.fleet.lane_steps")
+    fleet = _analyze_corpus(corpus, fleet=True)
+    assert fleet == sequential
+    assert metrics.value("frontier.fleet.phases") > phases_before
+    # per-contract occupancy counters decoded off the device counter plane
+    assert metrics.labels("frontier.fleet.lane_steps")
+
+
+def test_fleet_deadline_drain():
+    """One starved member (1 s budget, expired before its first chunk
+    drain) is deadline-drained on device and reports incomplete; the
+    other members complete with their issues."""
+    from mythril_tpu.analysis.security import fire_lasers
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.parallel.frontier import FleetDriver, FleetMember
+
+    _fresh_engine()
+    specs = [("branchy", _creation_hex(BRANCHY), 240),
+             ("addflow", _creation_hex(ADDFLOW), 1),
+             ("combo", _creation_hex(COMBO), 240)]
+    members = []
+    for index, (name, creation, budget) in enumerate(specs):
+        member = FleetMember(index, name, execution_timeout=budget)
+
+        def work(member=member, creation=creation, budget=budget):
+            sym = SymExecWrapper(
+                creation, address=None, strategy="bfs", max_depth=128,
+                execution_timeout=budget, create_timeout=30,
+                transaction_count=1, compulsory_statespace=False,
+                modules=MODULES, engine="tpu", fleet=member)
+            return fire_lasers(sym, MODULES)
+
+        member.work = work
+        members.append(member)
+    FleetDriver(members).run()
+
+    starved = members[1]
+    assert starved.error is None, starved.traceback_str
+    laser = starved.gate_laser or starved.laser
+    assert laser is not None and laser.timed_out, \
+        "starved member did not report incomplete"
+    for member in (members[0], members[2]):
+        assert member.error is None, member.traceback_str
+        laser = member.gate_laser or member.laser
+        assert laser is not None and not getattr(laser, "timed_out", False), \
+            f"{member.contract_id} was starved by the fleet"
+    # the survivors' detections came through
+    assert any(issue.swc_id == "106" for issue in members[0].result or []), \
+        "branchy lost its SWC-106 detection in the drained fleet"
+
+
+def test_host_checkpoint_contract_namespace(tmp_path):
+    """v2 host checkpoints stamp the contract id; a resume for another
+    contract degrades to a fresh run instead of restoring foreign state."""
+    from mythril_tpu.support.checkpoint import (REQUIRED_KEYS,
+                                                load_host_checkpoint,
+                                                save_host_checkpoint)
+
+    assert "contract_id" in REQUIRED_KEYS
+
+    class Laser:
+        pass
+
+    laser = Laser()
+    laser.open_states = []
+    laser.work_list = []
+    laser.executed_nodes = 7
+    laser.total_states = 9
+    laser.contract_id = "alpha"
+    path = str(tmp_path / "fleet.ckpt")
+    save_host_checkpoint(path, laser, tx_index=1)
+
+    payload = load_host_checkpoint(path, expected_contract_id="alpha")
+    assert payload is not None and payload["contract_id"] == "alpha"
+    assert load_host_checkpoint(path, expected_contract_id="beta") is None
+    # unguarded loads (legacy solo runs) still work
+    assert load_host_checkpoint(path) is not None
+
+
+@pytest.mark.slow
+def test_fleet_full_corpus_parity():
+    """Scaled-up corpus A/B (two transactions, selector variants so the
+    swap isolation is tested across distinct keccak/storage namespaces):
+    every contract's detections identical between one fleet job and the
+    sequential sweep."""
+    corpus = [("branchy", _creation_hex(BRANCHY)),
+              ("addflow", _creation_hex(ADDFLOW)),
+              ("combo", _creation_hex(COMBO))]
+    # JUMPDEST padding keeps every variant's issue pcs distinct: all
+    # contracts share the disassembler's fake address and unresolved
+    # selectors report as "fallback", so same-shape variants would
+    # otherwise collapse into one report key
+    for pad, tag in enumerate(("a", "b", "c"), start=1):
+        corpus.append((f"branchy_{tag}", _creation_hex(
+            {f"boom_{tag}()": "JUMPDEST\n" * pad + BRANCHY["boom()"]})))
+        corpus.append((f"addflow_{tag}", _creation_hex(
+            {f"bump_{tag}()": "JUMPDEST\n" * pad + ADDFLOW_BODY})))
+    sequential = _analyze_corpus(corpus, fleet=False, transaction_count=2)
+    fleet = _analyze_corpus(corpus, fleet=True, transaction_count=2)
+    assert fleet == sequential
+    missing = [name for name, found in sequential.items() if not found]
+    assert not missing, f"baseline lost detections for {missing}"

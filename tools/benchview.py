@@ -18,6 +18,8 @@ Tracked numbers and their comparability keys:
   a 128-lane CPU run, so heterogeneous history stays green;
 * ``merge_ab.wall_speedup`` / ``merge_ab.states_ratio``, keyed by
   (backend, chunk);
+* ``fleet_ab.wall_speedup`` / ``fleet_ab.flush_occupancy_ratio``, keyed
+  by (backend, contracts) — the fleet-vs-sequential corpus A/B;
 * the corpus sweep medians and finding totals per engine, keyed by
   (engine, budget_s).
 
@@ -102,6 +104,15 @@ def extract_points(round_label: str, run: dict) -> List[Point]:
             if field_value is not None:
                 series = f"merge_ab.{field}"
                 key = (series, parsed.get("backend"), merge.get("chunk"))
+                points.append(Point(series, key, round_label,
+                                    field_value, "x"))
+    fleet = parsed.get("fleet_ab")
+    if isinstance(fleet, dict):
+        for field in ("wall_speedup", "flush_occupancy_ratio"):
+            field_value = _num(fleet.get(field))
+            if field_value is not None:
+                series = f"fleet_ab.{field}"
+                key = (series, parsed.get("backend"), fleet.get("contracts"))
                 points.append(Point(series, key, round_label,
                                     field_value, "x"))
     corpus = parsed.get("corpus")

@@ -43,6 +43,11 @@ echo "== benchview (perf-regression sentinel over BENCH_r*.json) =="
 timeout -k 10 60 python -m tools.benchview || exit $?
 
 echo
+echo "== fleet smoke (2-contract fleet A/B: shared dispatch flush + parity) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m tools.fleet_smoke || exit $?
+
+echo
 echo "== serve smoke (daemon start -> request -> metrics scrape -> clean shutdown) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu \
     python -m tools.serve_smoke || exit $?

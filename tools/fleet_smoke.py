@@ -1,0 +1,150 @@
+"""Fleet-mode smoke for the pre-merge gate (tools/check.sh).
+
+Packs two tiny single-transaction contracts — a reconverging
+selfdestruct diamond (SWC-106) and an additive-overflow store
+(SWC-101), merge_smoke-sized so the whole A/B fits the gate budget —
+into ONE device fleet (MythrilAnalyzer fleet_contract_results ->
+parallel/frontier.py FleetDriver) and checks the tentpole's two
+promises:
+
+1. **Parity**: per-contract detections from the fleet run are identical
+   to two sequential runs of the same corpus (same process, same knobs —
+   the per-turn singleton swap must make each member's namespace
+   indistinguishable from a solo run's);
+2. **Shared dispatch**: at least one batched solver flush carried
+   queries from BOTH contracts (dispatch.shared_flush_count), proving
+   the merged solver traffic actually shares device launches.
+
+Prints ``FLEET_SMOKE=ok`` on success; any failure exits non-zero with a
+diagnostic.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("MYTHRIL_TPU_LANES", "16")
+# escape-time feasibility pruning is the device-phase solver traffic that
+# both contracts contribute to one queue; a high flush threshold lets the
+# batch fill from both members before the first demanded result ships it
+os.environ.setdefault("MYTHRIL_TPU_CHECK_ESCAPES", "1")
+os.environ.setdefault("MYTHRIL_TPU_BATCH_FLUSH", "64")
+# the 50 ms age flush would split the cross-member prefetch union into
+# timing-dependent fragments on slow CPU host turns — park it so the
+# shared-flush assertion sees the merged batch, not its shrapnel
+os.environ.setdefault("MYTHRIL_TPU_BATCH_AGE_MS", "60000")
+# the gate runs on CPU, where a host-emulated device SAT solve over real
+# path cones takes minutes per flush: cap the device lane out so every
+# query falls back (loudly, counted) to native CDCL. Flush composition —
+# the thing this smoke asserts — is accounted before the solve either
+# way; actual device solving is TPU-only per the BASELINE round-8 policy.
+os.environ.setdefault("MYTHRIL_TPU_DEVICE_CLAUSE_CAP", "1")
+
+MODULES = ["AccidentallyKillable", "IntegerArithmetics"]
+TX_COUNT = 1
+
+#: reconverging diamond ahead of an unprotected SELFDESTRUCT (the
+#: merge_smoke shape) — SWC-106 in one transaction
+BRANCHY = {
+    "boom()":
+        "PUSH1 0x00\nCALLDATALOAD\nPUSH1 0x01\nAND\n"
+        "PUSH @odd\nJUMPI\n"
+        "PUSH1 0x07\nPUSH @join\nJUMP\n"
+        "odd:\nJUMPDEST\nPUSH1 0x05\nJUMPDEST\n"
+        "join:\nJUMPDEST\nPUSH1 0x00\nSSTORE\nJUMPDEST\n"
+        "CALLER\nSELFDESTRUCT",
+}
+
+#: two symbolic calldata words ADDed and stored — SWC-101 in one
+#: transaction
+ADDFLOW = {
+    "bump()":
+        "PUSH1 0x04\nCALLDATALOAD\nPUSH1 0x24\nCALLDATALOAD\nADD\n"
+        "PUSH1 0x00\nSSTORE\n"
+        "PUSH1 0x01\nPUSH1 0x00\nMSTORE\nPUSH1 0x20\nPUSH1 0x00\nRETURN",
+}
+
+
+def _corpus():
+    from mythril_tpu.frontends.asm import (assemble, creation_wrapper,
+                                           dispatcher)
+
+    return [
+        ("branchy", creation_wrapper(assemble(dispatcher(BRANCHY))).hex()),
+        ("addflow", creation_wrapper(assemble(dispatcher(ADDFLOW))).hex()),
+    ]
+
+
+def _analyze(fleet: bool):
+    """One corpus run; returns {contract: sorted detection digests}."""
+    from mythril_tpu.analysis.security import reset_callback_modules
+    from mythril_tpu.mythril import MythrilAnalyzer, MythrilDisassembler
+    from mythril_tpu.smt.solver.solver import reset_solver_backend
+
+    reset_solver_backend()
+    reset_callback_modules()
+    disassembler = MythrilDisassembler()
+    address = None
+    for name, code in _corpus():
+        address, contract = disassembler.load_from_bytecode(code, False)
+        contract.name = name
+
+    class Cmd:
+        pass
+
+    cmd = Cmd()
+    cmd.engine = "tpu"
+    cmd.solver = "jax"
+    cmd.fleet = fleet
+    cmd.execution_timeout = 240
+    cmd.create_timeout = 60
+    cmd.max_depth = 128
+    analyzer = MythrilAnalyzer(disassembler, cmd_args=cmd, strategy="bfs",
+                               address=address)
+    report = analyzer.fire_lasers(modules=MODULES,
+                                  transaction_count=TX_COUNT)
+    digests = {}
+    for _, issue in sorted(report.issues.items()):
+        digests.setdefault(issue.contract, []).append(
+            (issue.swc_id, issue.address, issue.function,
+             [step.get("input", "")[:10] for step in
+              issue.transaction_sequence["steps"]]))
+    for detections in digests.values():
+        detections.sort()
+    return digests
+
+
+def main() -> int:
+    from mythril_tpu.smt.solver import dispatch
+
+    sequential = _analyze(fleet=False)
+    shared_before = dispatch.shared_flush_count()
+    fleet = _analyze(fleet=True)
+    shared = dispatch.shared_flush_count() - shared_before
+
+    if not any(sequential.values()):
+        print(f"fleet_smoke: sequential baseline found no issues: "
+              f"{sequential}", file=sys.stderr)
+        return 1
+    if fleet != sequential:
+        print(f"fleet_smoke: detection mismatch\n  sequential: "
+              f"{sequential}\n  fleet:      {fleet}", file=sys.stderr)
+        return 1
+    if shared < 1:
+        print("fleet_smoke: no shared dispatch flush — the fleet run "
+              "never mixed both contracts' queries into one device batch",
+              file=sys.stderr)
+        return 1
+    issues = sum(len(v) for v in fleet.values())
+    print(f"fleet_smoke: {issues} detection(s) across {len(fleet)} "
+          f"contract(s) identical to sequential; {shared} shared "
+          f"dispatch flush(es)")
+    print("FLEET_SMOKE=ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,7 +20,10 @@ and samples them via ``observe/trace.py``'s counter API) and prints:
   analysis annotated;
 * **state-merge events** (``frontier.merges``): reconverged
   fork-sibling pairs the veritesting pass collapsed, and the ITE
-  blends it allocated doing so.
+  blends it allocated doing so;
+* **fleet occupancy** (``frontier.fleet``): lane-steps per contract in
+  a ``--fleet`` run with a Jain fairness index — how evenly the packed
+  frontier split the device between corpus members.
 
 With ``--metrics`` it also summarizes an fsync-atomic metrics snapshot
 (``analyze --metrics-out`` / ``MYTHRIL_TPU_METRICS`` /
@@ -52,6 +55,7 @@ CAUSES_TRACK = "frontier.causes"
 LIFECYCLE_TRACK = "frontier.lifecycle"
 TAGS_TRACK = "frontier.tags"
 MERGES_TRACK = "frontier.merges"
+FLEET_TRACK = "frontier.fleet"
 
 
 def load_trace(path: str) -> Tuple[List[dict], Dict[str, object]]:
@@ -187,6 +191,31 @@ def _tags_section(totals: Dict[str, float]) -> List[str]:
     return lines
 
 
+def _fleet_section(totals: Dict[str, float]) -> List[str]:
+    """Per-contract occupancy of a fleet run (lane-steps per member) and
+    a fairness number: 1.0 = every contract got an equal share of the
+    device, lower = one member starved the others."""
+    lines = ["", "== fleet occupancy (lane-steps per contract) =="]
+    if not totals:
+        lines.append("  (no frontier.fleet samples — single-contract run "
+                     "or --fleet off)")
+        return lines
+    shares = [v for v in totals.values() if v > 0]
+    total = sum(shares)
+    peak = max(totals.values()) or 1
+    for name, value in sorted(totals.items(), key=lambda kv: -kv[1]):
+        share = value / total * 100 if total else 0.0
+        lines.append(f"  [{share:5.1f}%] {name:<16} {value:>12.0f}  "
+                     f"|{_bar(value, peak):<{_BAR}}|")
+    if shares:
+        # Jain's fairness index: (sum x)^2 / (n * sum x^2)
+        fairness = total * total / (len(shares)
+                                    * sum(v * v for v in shares))
+        lines.append(f"  fairness (Jain): {fairness:.2f} over "
+                     f"{len(shares)} active contract(s)")
+    return lines
+
+
 def _merges_section(totals: Dict[str, float]) -> List[str]:
     lines = ["", "== state-merge events (veritesting) =="]
     if not totals:
@@ -216,6 +245,7 @@ def report(events: List[dict], other: Dict[str, object]) -> str:
     lifecycle = sum_series(counter_samples(events, LIFECYCLE_TRACK))
     tags = sum_series(counter_samples(events, TAGS_TRACK))
     merges = sum_series(counter_samples(events, MERGES_TRACK))
+    fleet = sum_series(counter_samples(events, FLEET_TRACK))
     n_counter = sum(1 for e in events if e.get("ph") == "C")
     lines.append(f"  counter samples: {n_counter} "
                  f"({len(lanes)} chunk(s) with lane telemetry)")
@@ -230,6 +260,7 @@ def report(events: List[dict], other: Dict[str, object]) -> str:
     lines.extend(_lifecycle_section(lifecycle))
     lines.extend(_tags_section(tags))
     lines.extend(_merges_section(merges))
+    lines.extend(_fleet_section(fleet))
     return "\n".join(lines)
 
 
