@@ -289,6 +289,19 @@ def _add_serve_args(parser: argparse.ArgumentParser) -> None:
                              "as MYTHRIL_TPU_FLEET_SERVE=1; join window / "
                              "batch size via MYTHRIL_TPU_FLEET_WINDOW_MS / "
                              "MYTHRIL_TPU_FLEET_MAX_BATCH)")
+    daemon.add_argument("--workers", type=int, default=None, metavar="N",
+                        help="run the engine in N supervised worker "
+                             "processes instead of in-process: a "
+                             "segfault/OOM/hang kills one sandbox, the "
+                             "request is retried once, repeat-offender "
+                             "contracts are quarantined (same as "
+                             "MYTHRIL_TPU_SERVE_WORKERS=N; 0 disables)")
+    daemon.add_argument("--inject-fault", default=None, metavar="SPEC",
+                        help="deterministic fault injection for the worker "
+                             "pool, e.g. worker_segv:2 (kill the worker on "
+                             "the 2nd dispatched job); same grammar as the "
+                             "analyze-side flag, worker_* classes fire at "
+                             "the supervisor's dispatch site")
 
 
 def _cmd_serve(cli_args) -> int:
@@ -301,7 +314,9 @@ def _cmd_serve(cli_args) -> int:
         manifest_path=cli_args.manifest or default_manifest_path(),
         warmup=False if cli_args.no_warmup else None,
         max_inflight=cli_args.max_inflight,
-        fleet=True if cli_args.fleet else None)
+        fleet=True if cli_args.fleet else None,
+        workers=cli_args.workers,
+        inject_fault=cli_args.inject_fault)
     if cli_args.stdio:
         from ..serve.daemon import serve_stdio
 

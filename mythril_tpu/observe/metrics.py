@@ -283,6 +283,29 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("serve.fleet.windows", COUNTER, "1",
                "Fleet micro-batch windows closed (one shared fleet run "
                "each, leader request included)."),
+    # -- serve worker-process pool (mythril_tpu/serve/supervisor.py) -------------
+    MetricSpec("serve.worker.spawns", COUNTER, "1",
+               "Worker processes spawned by the serve supervisor "
+               "(initial pool fill plus every restart)."),
+    MetricSpec("serve.worker.restarts", COUNTER, "1",
+               "Worker processes respawned after a death (exponential "
+               "per-slot backoff)."),
+    MetricSpec("serve.worker.deaths", HISTOGRAM, "1",
+               "Worker-process deaths by failure class (label = "
+               "worker_segv / worker_hang / worker_oom / worker_crash; "
+               "exit-status or heartbeat-timeout classified)."),
+    MetricSpec("serve.worker.retries", COUNTER, "1",
+               "Victim requests retried once on a fresh worker after a "
+               "worker death (checkpoint resume or host-ladder restart)."),
+    MetricSpec("serve.worker.quarantined", COUNTER, "1",
+               "Contracts newly recorded as poison in the quarantine "
+               "sidecar (crashed MYTHRIL_TPU_SERVE_QUARANTINE_AFTER "
+               "workers)."),
+    MetricSpec("serve.worker.quarantine_refusals", COUNTER, "1",
+               "Analyze requests refused with a `quarantined` error "
+               "because their bytecode hash is in the poison sidecar."),
+    MetricSpec("serve.worker.pool", GAUGE, "workers",
+               "Live worker processes in the serve supervisor pool."),
     # -- engine plugins (core/plugin/plugins/) -----------------------------------
     MetricSpec("profiler.instruction_us", HISTOGRAM, "us",
                "Per-opcode host-engine instruction latency "

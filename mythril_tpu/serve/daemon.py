@@ -86,21 +86,42 @@ def serve_socket(service, socket_path: Optional[str] = None,
     and warmup has finished — tests and supervisors wait on it."""
     path = socket_path or default_socket_path()
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    if os.path.exists(path):
-        # a live daemon would be reachable; a stale socket file from a
-        # crashed one just blocks bind() — probe before unlinking
-        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    # the probe/unlink/bind sequence below must be atomic across daemons:
+    # two starting at once against the same stale socket could both probe
+    # (dead), both unlink, and the second would silently unlink the
+    # *first's* freshly bound socket. A held flock on a sidecar lockfile
+    # serializes the whole reclaim-and-bind; the lock fd stays open for
+    # the daemon's lifetime so a loser fails fast instead of stealing.
+    lock_fd = os.open(path + ".lock", os.O_RDWR | os.O_CREAT, 0o600)
+    try:
         try:
-            probe.connect(path)
+            import fcntl
+            fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except ImportError:
+            pass  # non-POSIX: fall back to the probe alone
         except OSError:
-            os.unlink(path)
-        else:
-            probe.close()
-            raise RuntimeError(f"daemon already listening on {path}")
-    server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            raise RuntimeError(
+                f"daemon already starting or listening on {path} "
+                f"(lock {path}.lock is held)")
+        if os.path.exists(path):
+            # a live daemon would be reachable; a stale socket file from
+            # a crashed one just blocks bind() — probe before unlinking
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                probe.connect(path)
+            except OSError:
+                log.warning("reclaiming stale socket %s", path)
+                os.unlink(path)
+            else:
+                probe.close()
+                raise RuntimeError(f"daemon already listening on {path}")
+        server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        server.bind(path)
+    except BaseException:
+        os.close(lock_fd)
+        raise
     accepted = 0
     try:
-        server.bind(path)
         os.chmod(path, 0o600)
         server.listen(8)
         server.settimeout(0.25)
@@ -136,6 +157,11 @@ def serve_socket(service, socket_path: Optional[str] = None,
                    connections=accepted)
         try:
             os.unlink(path)
+        except OSError:
+            pass
+        os.close(lock_fd)  # releases the flock
+        try:
+            os.unlink(path + ".lock")
         except OSError:
             pass
     return accepted

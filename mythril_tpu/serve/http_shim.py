@@ -88,8 +88,14 @@ class _Handler(BaseHTTPRequestHandler):
                 error.request_id, error.code, error.message))
             return
         reply = self.service.handle(request)
-        status = 200 if reply.get("ok") else \
-            (429 if reply["error"]["code"] == "busy" else 400)
+        if reply.get("ok"):
+            status = 200
+        elif reply["error"]["code"] == "busy":
+            status = 429  # Too Many Requests: back off and retry
+        elif reply["error"]["code"] == "quarantined":
+            status = 409  # Conflict: the resource itself is refused
+        else:
+            status = 400
         self._reply(status, reply)
 
 

@@ -24,8 +24,10 @@ the request ``id`` (auto-assigned ``req-N`` when absent) and carry either
 
 Error codes: ``line_too_long``, ``bad_json``, ``bad_request``,
 ``unknown_op``, ``busy`` (in-flight bound reached — retry later),
-``shutting_down``, ``analysis_failed``. Validation failures never kill
-the connection: the daemon replies with the error and keeps reading.
+``shutting_down``, ``analysis_failed``, ``quarantined`` (this bytecode
+has repeatedly killed worker processes and is refused at admission —
+see serve/quarantine.py). Validation failures never kill the
+connection: the daemon replies with the error and keeps reading.
 
 ``deadline_ms`` rides the engine's existing deadline-drain substrate: it
 becomes the analysis execution timeout, so an over-deadline request

@@ -25,6 +25,15 @@ into :meth:`handle`, so every transport shares one behavior:
   span carrying the request id and its warm/cold dispatch counts
   (``xla.bucket_compiles``/``bucket_reuses`` deltas), which is what
   ``tools/traceview.py``'s per-request rollup renders.
+* **Worker isolation** (``MYTHRIL_TPU_SERVE_WORKERS`` / ``serve
+  --workers N``): with a pool configured, the engine never runs in the
+  daemon process — each analyze (or fleet micro-batch) is dispatched to
+  a supervised, manifest-warmed worker process
+  (serve/supervisor.py), so a segfault/OOM/hang kills one sandbox, the
+  victim request is retried once, and repeat offenders land in the
+  poison-quarantine sidecar (answered with a typed ``quarantined``
+  error). The engine lock is bypassed in this mode: the pool itself is
+  the execution-capacity gate.
 """
 
 from __future__ import annotations
@@ -36,6 +45,7 @@ import time
 from typing import Dict, Optional
 
 from . import protocol
+from .quarantine import QuarantinedContract
 from .warmset import WarmSet
 from ..observe import export, metrics, slog, trace
 from ..support import tpu_config
@@ -52,6 +62,22 @@ _FRONTIER_COUNTERS = ("executed", "forks", "escapes", "reseeds", "deaths",
 def _frontier_counters() -> Dict[str, int]:
     return {name: int(metrics.value(f"frontier.telemetry.{name}"))
             for name in _FRONTIER_COUNTERS}
+
+
+def execution_timeout_s(deadline_ms: Optional[int]) -> float:
+    """A request's ``deadline_ms`` as the engine execution timeout in
+    seconds, clamped to the ``MYTHRIL_TPU_SERVE_MAX_DEADLINE_MS``
+    ceiling; a request without a deadline gets the full ceiling (one
+    day by default — "no deadline" still must not wedge a worker
+    forever). Shared by the solo path, the fleet batcher, and the
+    worker process, so every execution route prices a deadline the same
+    way."""
+    max_ms = tpu_config.get_int("MYTHRIL_TPU_SERVE_MAX_DEADLINE_MS")
+    if deadline_ms:
+        if max_ms and deadline_ms > max_ms:
+            deadline_ms = max_ms
+        return max(deadline_ms / 1000.0, 0.001)
+    return max(max_ms / 1000.0, 0.001) if max_ms else 86400.0
 
 
 class _RequestArgs:
@@ -119,8 +145,14 @@ class _FleetBatcher:
                 time.sleep(window_s)
             with self._lock:
                 group = self._waiting.pop(key)
-            with self.service._engine_lock:
-                self._run_batch(group)
+            if self.service._supervisor is not None:
+                # worker mode: the batch runs in a supervised worker
+                # process; the pool is the capacity gate, not the
+                # daemon's engine lock
+                self._run_batch_workers(group)
+            else:
+                with self.service._engine_lock:
+                    self._run_batch(group)
         ticket.done.wait()
         if ticket.error is not None:
             raise ticket.error
@@ -138,6 +170,52 @@ class _FleetBatcher:
                     ticket.error = error
                     ticket.done.set()
             raise
+
+    def _run_batch_workers(self, group: list) -> None:
+        """Leader-side, worker mode: quarantined members are refused
+        individually (an innocent co-member must not lose its slot to a
+        poison contract), then the surviving members ship to one worker
+        as a single fleet job — death retry and ladder fallback are the
+        supervisor's job. Always completes every ticket."""
+        from . import quarantine
+        from .supervisor import WorkerAnalysisError
+
+        supervisor = self.service._supervisor
+        live = []
+        for ticket in group:
+            try:
+                supervisor._check_quarantine(
+                    quarantine.contract_key(ticket.params.get("code")))
+            except quarantine.QuarantinedContract as error:
+                ticket.error = error
+                ticket.done.set()
+                continue
+            live.append(ticket)
+        if not live:
+            return
+        if len(live) >= 2:
+            metrics.inc("serve.fleet.windows")
+            metrics.inc("serve.fleet.batched", len(live))
+            slog.event("serve.fleet.batch", requests=len(live),
+                       workers=True)
+        try:
+            outcomes = supervisor.run_fleet(
+                [ticket.params for ticket in live], cid=live[0].cid)
+        except BaseException as error:  # noqa: BLE001 — demuxed per ticket
+            for ticket in live:
+                if not ticket.done.is_set():
+                    ticket.error = error
+                    ticket.done.set()
+            raise
+        for ticket, outcome in zip(live, outcomes):
+            if isinstance(outcome, dict) and outcome.get("ok"):
+                ticket.payload = outcome.get("payload") or {}
+            else:
+                outcome = outcome if isinstance(outcome, dict) else {}
+                ticket.error = WorkerAnalysisError(
+                    outcome.get("error_type", "Exception"),
+                    outcome.get("error", "fleet member failed in worker"))
+            ticket.done.set()
 
     def _run_batch_inner(self, group: list) -> None:
         from ..analysis.report import Report
@@ -159,11 +237,8 @@ class _FleetBatcher:
         cmd.engine = params.get("engine") or self.service.engine
         cmd.max_depth = params["max_depth"]
         cmd.fleet = True
-        deadline_ms = params.get("deadline_ms")
-        if deadline_ms:
-            cmd.execution_timeout = max(deadline_ms / 1000.0, 0.001)
-        else:
-            cmd.execution_timeout = 86400
+        cmd.execution_timeout = execution_timeout_s(
+            params.get("deadline_ms"))
         disassembler = MythrilDisassembler()
         address = None
         live: list = []
@@ -210,7 +285,9 @@ class AnalysisService:
                  manifest_path: Optional[str] = None,
                  warmup: Optional[bool] = None,
                  max_inflight: Optional[int] = None,
-                 fleet: Optional[bool] = None):
+                 fleet: Optional[bool] = None,
+                 workers: Optional[int] = None,
+                 inject_fault: Optional[str] = None):
         self.solver = solver
         self.engine = engine
         self.strategy = strategy
@@ -225,6 +302,18 @@ class AnalysisService:
         if max_inflight is None:
             max_inflight = tpu_config.get_int("MYTHRIL_TPU_SERVE_MAX_INFLIGHT")
         self.max_inflight = max(1, max_inflight)
+        if workers is None:
+            workers = tpu_config.get_int("MYTHRIL_TPU_SERVE_WORKERS")
+        self.workers = max(0, int(workers or 0))
+        self._supervisor = None
+        if self.workers > 0:
+            from .supervisor import Supervisor
+
+            self._supervisor = Supervisor(
+                self.workers, manifest_path=manifest_path,
+                solver=self.solver, engine=self.engine,
+                strategy=self.strategy, warmup=self.warmup_enabled,
+                inject_fault=inject_fault)
         self._gate = threading.BoundedSemaphore(self.max_inflight)
         self._engine_lock = threading.Lock()
         self._started = time.monotonic()
@@ -243,12 +332,19 @@ class AnalysisService:
             trace.enable(trace_out)
         trace.set_manifest(serve_solver=self.solver,
                            serve_engine=self.engine)
-        if self.warmup_enabled:
+        if self._supervisor is not None:
+            # worker mode: each worker pre-warms from the manifest at
+            # spawn; warming the daemon process too would pay the
+            # compile cliff twice for an engine that never runs here
+            self._supervisor.start()
+        elif self.warmup_enabled:
             self.warmset.warmup()
             self.warmset.record_observed()
 
     def shutdown(self) -> None:
         self.shutting_down.set()
+        if self._supervisor is not None:
+            self._supervisor.stop()
         self.warmset.record_observed()
         trace.export()
 
@@ -306,6 +402,11 @@ class AnalysisService:
                     # engine lock for the whole fleet step; followers
                     # park on their ticket instead of queueing here
                     return self._analyze(request, cid, fleet=True)
+                if self._supervisor is not None:
+                    # worker mode: execution capacity is the pool, not
+                    # the in-process engine — no engine lock, so two
+                    # workers genuinely run two requests in parallel
+                    return self._analyze(request, cid)
                 with self._engine_lock:
                     return self._analyze(request, cid)
         finally:
@@ -326,7 +427,9 @@ class AnalysisService:
             warm={"cold_buckets": int(metrics.value("xla.bucket_compiles")),
                   "warm_hits": int(metrics.value("xla.bucket_reuses")),
                   "warmset": self.warmset.status()},
-            frontier=_frontier_counters())
+            frontier=_frontier_counters(),
+            workers=(self._supervisor.status()
+                     if self._supervisor is not None else None))
 
     def _metrics(self, request) -> Dict:
         """Scrape (the `metrics` op / GET /metrics): the full registry
@@ -354,6 +457,8 @@ class AnalysisService:
             fleet=self.fleet,
             max_inflight=self.max_inflight,
             warmset=self.warmset.status(),
+            workers=(self._supervisor.status()
+                     if self._supervisor is not None else None),
             cached_verdicts=dispatch.cached_verdicts(),
             metrics=metrics.snapshot())
 
@@ -372,6 +477,18 @@ class AnalysisService:
                     payload = self._run_analysis(params)
             except (KeyboardInterrupt, SystemExit):
                 raise
+            except QuarantinedContract as error:
+                log.warning("refusing quarantined contract for request "
+                            "%r: %s", request.id, error)
+                metrics.inc("serve.requests")
+                metrics.inc("serve.request_errors")
+                span.set(error="quarantined")
+                slog.event("serve.reply", request_id=str(request.id),
+                           ok=False, error="quarantined")
+                reply = protocol.error_reply(request.id, "quarantined",
+                                             str(error))
+                reply["correlation_id"] = cid
+                return reply
             except Exception as error:
                 log.exception("analysis failed for request %r", request.id)
                 metrics.inc("serve.requests")
@@ -414,7 +531,21 @@ class AnalysisService:
             **payload)
 
     def _run_analysis(self, params: Dict) -> Dict:
-        """The per-request engine run: isolate, load, fire lasers."""
+        """Route one request to the engine: in worker mode the supervisor
+        dispatches it to a pooled sandbox process (with death detection,
+        retry, and quarantine); otherwise it runs in-process."""
+        if self._supervisor is not None:
+            return self._supervisor.run_job(params,
+                                            cid=slog.correlation_id())
+        return self._run_analysis_local(params)
+
+    def _run_analysis_local(self, params: Dict,
+                            checkpoint_path: Optional[str] = None,
+                            resume_path: Optional[str] = None) -> Dict:
+        """The per-request engine run: isolate, load, fire lasers.
+        `checkpoint_path`/`resume_path` are worker-mode extras: the
+        request-scoped checkpoint the supervisor assigns so a killed
+        worker's one retry can resume mid-analysis."""
         from ..analysis.security import reset_callback_modules
         from ..mythril import MythrilAnalyzer, MythrilDisassembler
         from ..smt.solver.solver import reset_solver_backend
@@ -428,11 +559,11 @@ class AnalysisService:
         cmd.solver = params.get("solver") or self.solver
         cmd.engine = params.get("engine") or self.engine
         cmd.max_depth = params["max_depth"]
-        deadline_ms = params.get("deadline_ms")
-        if deadline_ms:
-            cmd.execution_timeout = max(deadline_ms / 1000.0, 0.001)
-        else:
-            cmd.execution_timeout = 86400
+        cmd.execution_timeout = execution_timeout_s(params.get("deadline_ms"))
+        if checkpoint_path:
+            cmd.checkpoint = checkpoint_path
+        if resume_path:
+            cmd.resume = resume_path
         disassembler = MythrilDisassembler()
         address, contract = disassembler.load_from_bytecode(
             params["code"], params["bin_runtime"])

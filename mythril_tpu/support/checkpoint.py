@@ -28,6 +28,7 @@ from __future__ import annotations
 import logging
 import os
 import pickle
+import re
 import sys
 import time
 from typing import Optional
@@ -67,6 +68,18 @@ def fsync_replace(tmp: str, path: str) -> None:
             os.close(dir_fd)
     except OSError:
         pass  # non-POSIX/odd filesystems: rename atomicity still holds
+
+
+def request_checkpoint_path(base_dir: str, request_key: str) -> str:
+    """Request-scoped checkpoint path for a serve worker job: one file
+    per in-flight request under the supervisor's scratch dir, so a
+    worker cut down mid-analysis leaves a checkpoint its one retry can
+    resume from — and two concurrent requests (even for the same
+    contract) never share a file. The key is sanitized to a safe
+    filename; the caller deletes the file after the request's final
+    outcome."""
+    safe = re.sub(r"[^A-Za-z0-9._-]", "_", request_key)[:80] or "req"
+    return os.path.join(base_dir, f"req-{safe}.ckpt")
 
 
 def checkpoint_state_interval() -> int:

@@ -35,6 +35,7 @@ starts with healthy backends.
 from __future__ import annotations
 
 import logging
+import signal
 from typing import Callable, Dict, List, Optional, Tuple
 
 from . import tpu_config
@@ -59,9 +60,16 @@ NATIVE_CRASH = "native_crash"
 #: injection-only: simulated kill of the host laser loop (exercises the
 #: checkpoint/resume path; never produced by classify_failure)
 HOST_CRASH = "host_crash"
+#: serve worker process died on a fatal signal (SIGSEGV/SIGBUS/SIGABRT)
+WORKER_SEGV = "worker_segv"
+#: serve worker process stopped heartbeating and had to be killed
+WORKER_HANG = "worker_hang"
+#: serve worker process was OOM-killed (SIGKILL) or raised MemoryError
+WORKER_OOM = "worker_oom"
 
 FAILURE_CLASSES = (DEVICE_OOM, COMPILE_ERROR, WALL_OVERRUN, WORKER_CRASH,
-                   DIVERGENCE, NATIVE_CRASH, HOST_CRASH)
+                   DIVERGENCE, NATIVE_CRASH, HOST_CRASH,
+                   WORKER_SEGV, WORKER_HANG, WORKER_OOM)
 
 #: backend names in ladder order (PYTHON is the floor: never gated)
 DEVICE, NATIVE, PYTHON = "device", "native", "python"
@@ -98,6 +106,18 @@ class NativeCrash(BackendFailure):
     failure_class = NATIVE_CRASH
 
 
+class WorkerSegv(BackendFailure):
+    failure_class = WORKER_SEGV
+
+
+class WorkerHang(BackendFailure):
+    failure_class = WORKER_HANG
+
+
+class WorkerOOM(BackendFailure):
+    failure_class = WORKER_OOM
+
+
 class InjectedCrash(BaseException):
     """Simulated kill -9 of the analysis loop (`--inject-fault host_crash:N`).
     BaseException on purpose: it must sail through every `except Exception`
@@ -115,6 +135,9 @@ _EXCEPTION_FOR_CLASS = {
     WORKER_CRASH: DeviceWorkerCrash,
     NATIVE_CRASH: NativeCrash,
     HOST_CRASH: InjectedCrash,
+    WORKER_SEGV: WorkerSegv,
+    WORKER_HANG: WorkerHang,
+    WORKER_OOM: WorkerOOM,
 }
 
 #: which injection boundary ("site") each failure class fires at
@@ -126,6 +149,12 @@ SITE_OF_CLASS = {
     DIVERGENCE: "divergence",
     NATIVE_CRASH: NATIVE,
     HOST_CRASH: "host",
+    # worker classes fire at the serve supervisor's job-dispatch boundary
+    # (serve/supervisor.py visits "worker" once per job handed to a
+    # worker process; the worker then genuinely dies that way)
+    WORKER_SEGV: "worker",
+    WORKER_HANG: "worker",
+    WORKER_OOM: "worker",
 }
 
 #: substrings of exception type names / messages that identify OOMs. XLA
@@ -139,22 +168,57 @@ _COMPILE_MSG_MARKERS = ("INVALID_ARGUMENT", "compilation", "lowering",
                         "abstract value", "jit")
 
 
-def classify_failure(error: BaseException) -> str:
+def classify_failure(error: BaseException,
+                     context: Optional[str] = None) -> str:
     """Map an arbitrary backend exception to a failure class. Typed
     injection exceptions carry their class; real errors classify by type
-    and message shape, defaulting to WORKER_CRASH (the catch-all domain)."""
+    and message shape, defaulting to WORKER_CRASH (the catch-all domain).
+
+    ``context="worker"`` classifies on behalf of a serve worker process:
+    memory exhaustion there is the worker's own failure domain
+    (WORKER_OOM — the sandbox died, not the device), while the default
+    context keeps the historical DEVICE_OOM mapping for in-process
+    backend errors."""
     if isinstance(error, BackendFailure):
         return error.failure_class
     name = type(error).__name__
     text = f"{name}: {error}"
     if isinstance(error, MemoryError) or \
             any(marker in text for marker in _OOM_MARKERS):
-        return DEVICE_OOM
+        return WORKER_OOM if context == "worker" else DEVICE_OOM
     if isinstance(error, TimeoutError):
         return WALL_OVERRUN
     if any(marker in name for marker in _COMPILE_TYPE_MARKERS) or \
             any(marker in str(error) for marker in _COMPILE_MSG_MARKERS):
         return COMPILE_ERROR
+    return WORKER_CRASH
+
+
+#: fatal signals that mean "the process itself blew up" (not a kill)
+_SEGV_SIGNALS = frozenset(
+    getattr(signal, sig_name)
+    for sig_name in ("SIGSEGV", "SIGBUS", "SIGABRT", "SIGILL", "SIGFPE")
+    if hasattr(signal, sig_name))
+
+
+def classify_exit_status(returncode: Optional[int]) -> Optional[str]:
+    """Map a child process's ``Popen.returncode`` to a worker failure
+    class, or None for a clean (or still-running) exit.
+
+    Negative return codes are ``-signum`` (POSIX): SIGSEGV/SIGBUS/
+    SIGABRT/SIGILL/SIGFPE classify as WORKER_SEGV (the process's own
+    fault), SIGKILL as WORKER_OOM (the kernel OOM killer is the only
+    expected uninvited SIGKILL source), anything else signal-ish or a
+    non-zero exit as WORKER_CRASH."""
+    if returncode is None or returncode == 0:
+        return None
+    if returncode < 0:
+        signum = -returncode
+        if signum in _SEGV_SIGNALS:
+            return WORKER_SEGV
+        if signum == getattr(signal, "SIGKILL", 9):
+            return WORKER_OOM
+        return WORKER_CRASH
     return WORKER_CRASH
 
 

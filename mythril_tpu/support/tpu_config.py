@@ -143,6 +143,28 @@ _KNOBS: List[Knob] = [
     Knob("MYTHRIL_TPU_SERVE_WARMUP", "flag", True,
          "Run the AOT warmup phase (manifest-driven bucket pre-compile) "
          "at daemon startup; `serve --no-warmup` also disables it."),
+    Knob("MYTHRIL_TPU_SERVE_MAX_DEADLINE_MS", "int", 86_400_000,
+         "Ceiling applied to a request's `deadline_ms` before it becomes "
+         "the analysis execution timeout; requests without a deadline "
+         "get the full ceiling (default: one day)."),
+    Knob("MYTHRIL_TPU_SERVE_WORKERS", "int", 0,
+         "Worker-process pool size for `myth-tpu serve`: each analyze "
+         "(or fleet micro-batch) executes in a supervised, manifest-"
+         "warmed worker process so a crash kills only that request's "
+         "sandbox; 0 (the default) keeps the legacy in-process engine; "
+         "`serve --workers N` sets the same pool size."),
+    Knob("MYTHRIL_TPU_SERVE_WORKER_HEARTBEAT_MS", "int", 30_000,
+         "Supervisor heartbeat timeout (ms): a busy worker that writes "
+         "neither a heartbeat nor a result for this long is killed and "
+         "its death classified WORKER_HANG."),
+    Knob("MYTHRIL_TPU_SERVE_WORKER_BACKOFF_MS", "int", 250,
+         "Base delay (ms) before a dead worker slot respawns; doubles "
+         "per consecutive death on the slot (capped at 30 s) and resets "
+         "on a completed job."),
+    Knob("MYTHRIL_TPU_SERVE_QUARANTINE_AFTER", "int", 2,
+         "Worker deaths attributed to one bytecode hash before the "
+         "contract lands in the poison-quarantine sidecar and further "
+         "requests for it are refused with a `quarantined` error."),
     # -- observability (mythril_tpu/observe/) -------------------------------------
     Knob("MYTHRIL_TPU_TRACE", "str", None,
          "Write a Chrome/Perfetto trace_event JSON to this path; setting "

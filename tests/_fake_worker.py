@@ -1,0 +1,85 @@
+"""Scripted stand-in for ``python -m mythril_tpu.serve.worker``.
+
+Speaks the supervisor's JSON-lines protocol (ready / heartbeat /
+result) without importing mythril_tpu — supervisor unit tests spawn it
+via the ``worker_argv`` override so death detection, retry, backoff,
+and quarantine are exercised in milliseconds instead of paying a jax
+import per worker.
+
+Behavior is driven by the job itself:
+
+* ``job["inject"]`` (set by the supervisor's fault plan) dies for real:
+  SIGSEGV / SIGKILL to self, or going silent for ``worker_hang``;
+* ``params["fake"]``: ``"exit3"`` exits with status 3 (plain
+  WORKER_CRASH), ``"clean_error"`` answers ``ok: false`` (a surviving
+  sandbox), ``"slow"`` emits ``params["beats"]`` heartbeats
+  ``params["beat_s"]`` apart before answering — long enough jobs only
+  survive because heartbeats reset the supervisor's deadline;
+* anything else answers ``ok: true`` with a payload echoing the job, so
+  tests can assert which dispatch (first try, ladder retry, resume
+  retry) produced the answer.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+
+def _send(**record):
+    sys.stdout.write(json.dumps(record) + "\n")
+    sys.stdout.flush()
+
+
+def main() -> int:
+    _send(event="ready", pid=os.getpid(), warmed=0)
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        job = json.loads(line)
+        if job.get("kind") == "shutdown":
+            break
+        job_id = job.get("job_id")
+        inject = job.get("inject")
+        if inject == "worker_segv":
+            signal.signal(signal.SIGSEGV, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGSEGV)
+        elif inject == "worker_oom":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif inject == "worker_hang":
+            while True:
+                time.sleep(3600)
+        if job.get("kind") == "fleet":
+            _send(event="result", job_id=job_id, ok=True,
+                  payload={"outcomes": [
+                      {"ok": True,
+                       "payload": {"issue_count": 0, "member": index,
+                                   "ladder": bool(job.get("ladder"))}}
+                      for index, _ in enumerate(job.get("members") or [])]})
+            continue
+        params = job.get("params") or {}
+        behavior = params.get("fake")
+        if behavior == "exit3":
+            return 3
+        if behavior == "clean_error":
+            _send(event="result", job_id=job_id, ok=False,
+                  error_type="ValueError", error="clean in-worker failure")
+            continue
+        if behavior == "slow":
+            for _ in range(int(params.get("beats", 3))):
+                _send(event="heartbeat", job_id=job_id)
+                time.sleep(float(params.get("beat_s", 0.2)))
+        _send(event="result", job_id=job_id, ok=True,
+              payload={"issue_count": 0, "pid": os.getpid(),
+                       "params": params, "retry": bool(job.get("retry")),
+                       "ladder": bool(job.get("ladder")),
+                       "resume": job.get("resume"),
+                       "serve_metrics": {"cold_buckets": 1, "warm_hits": 2,
+                                         "frontier": {}}})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,453 @@
+"""Worker-pool isolation tests: exit-status taxonomy, the poison
+quarantine sidecar, deadline clamping, the supervisor's death-detection
+/ retry / backoff machinery, and the flock-guarded socket reclaim.
+
+Supervisor tests spawn ``tests/_fake_worker.py`` (a jax-free scripted
+protocol peer) via the ``worker_argv`` override, so a full
+death-retry-quarantine cycle runs in milliseconds. The real-worker
+end-to-end lives in tools/chaos_smoke.py (pre-merge gate), not here."""
+
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+
+import pytest
+
+from mythril_tpu.observe import export, metrics, slog, trace
+from mythril_tpu.serve import daemon, protocol, quarantine
+from mythril_tpu.serve import client as serve_client
+from mythril_tpu.serve.service import AnalysisService, execution_timeout_s
+from mythril_tpu.serve.supervisor import (Supervisor, WorkerAnalysisError,
+                                          WorkerDeath)
+from mythril_tpu.support import resilience
+
+FAKE_WORKER = [sys.executable,
+               os.path.join(os.path.dirname(__file__), "_fake_worker.py")]
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    metrics.reset()
+    trace.reset()
+    slog.reset()
+    export.reset_ring()
+    yield
+    metrics.reset()
+    trace.reset()
+    slog.reset()
+    export.reset_ring()
+
+
+def _supervisor(tmp_path, **overrides):
+    workers = overrides.pop("workers", 1)
+    defaults = dict(
+        manifest_path=str(tmp_path / "warmset.json"),
+        worker_argv=FAKE_WORKER, heartbeat_ms=2000, backoff_ms=10,
+        quarantine_after=2)
+    defaults.update(overrides)
+    return Supervisor(workers, **defaults)
+
+
+# -- satellite 2: exit-status and worker-context classification ----------------------
+
+
+@pytest.mark.parametrize("signum", [
+    signal.SIGSEGV, signal.SIGBUS, signal.SIGABRT, signal.SIGILL,
+    signal.SIGFPE])
+def test_classify_exit_status_fatal_signals(signum):
+    assert resilience.classify_exit_status(-signum) == \
+        resilience.WORKER_SEGV
+
+
+def test_classify_exit_status_sigkill_is_oom():
+    assert resilience.classify_exit_status(-signal.SIGKILL) == \
+        resilience.WORKER_OOM
+
+
+def test_classify_exit_status_other_deaths_are_crashes():
+    assert resilience.classify_exit_status(-signal.SIGTERM) == \
+        resilience.WORKER_CRASH
+    assert resilience.classify_exit_status(3) == resilience.WORKER_CRASH
+
+
+def test_classify_exit_status_clean_exit_is_none():
+    assert resilience.classify_exit_status(0) is None
+    assert resilience.classify_exit_status(None) is None
+
+
+def test_classify_failure_worker_context_maps_memoryerror():
+    # the historical in-process mapping must not move (DEVICE_OOM)...
+    assert resilience.classify_failure(MemoryError()) == \
+        resilience.DEVICE_OOM
+    # ...while the worker context charges the sandbox's own domain
+    assert resilience.classify_failure(MemoryError(), context="worker") == \
+        resilience.WORKER_OOM
+    assert resilience.classify_failure(
+        RuntimeError("RESOURCE_EXHAUSTED: hbm"), context="worker") == \
+        resilience.WORKER_OOM
+
+
+def test_worker_failure_classes_have_typed_exceptions_and_sites():
+    for cls in (resilience.WORKER_SEGV, resilience.WORKER_HANG,
+                resilience.WORKER_OOM):
+        assert cls in resilience.FAILURE_CLASSES
+        assert resilience.SITE_OF_CLASS[cls] == "worker"
+        exc = resilience._EXCEPTION_FOR_CLASS[cls]("boom")
+        assert resilience.classify_failure(exc) == cls
+
+
+# -- quarantine sidecar ---------------------------------------------------------------
+
+
+def test_contract_key_normalizes_hex():
+    base = quarantine.contract_key("6001600055")
+    assert quarantine.contract_key("0x6001600055") == base
+    assert quarantine.contract_key("  0X6001600055\n") == base
+    assert quarantine.contract_key("6001600056") != base
+
+
+def test_quarantine_path_sits_beside_manifest():
+    assert quarantine.quarantine_path_for("/a/b/warmset.json") == \
+        "/a/b/warmset.quarantine.json"
+
+
+def test_quarantine_store_threshold_and_persistence(tmp_path):
+    path = str(tmp_path / "w.quarantine.json")
+    store = quarantine.QuarantineStore(path, threshold=2)
+    key = quarantine.contract_key("0xdead")
+    assert store.record_crash(key, resilience.WORKER_SEGV) is False
+    store.check(key)  # one crash: still admissible
+    assert store.record_crash(key, resilience.WORKER_HANG) is True
+    with pytest.raises(quarantine.QuarantinedContract):
+        store.check(key)
+    # a fresh store (daemon restart) reloads the verdict from disk
+    reloaded = quarantine.QuarantineStore(path, threshold=2)
+    assert reloaded.is_quarantined(key)
+    entry = reloaded.entry(key)
+    assert entry["crashes"] == 2
+    assert entry["classes"] == ["worker_hang", "worker_segv"]
+    assert reloaded.status()["quarantined"] == 1
+
+
+def test_quarantine_save_is_union_merge(tmp_path):
+    path = str(tmp_path / "q.json")
+    key = "k" * 64
+    quarantine.save_quarantine(path, {key: {
+        "crashes": 2, "classes": ["worker_segv"], "quarantined": True}})
+    # a second daemon with a stale in-memory view must not regress the
+    # verdict: max crashes, union classes, OR quarantined
+    quarantine.save_quarantine(path, {key: {
+        "crashes": 1, "classes": ["worker_oom"], "quarantined": False}})
+    merged = quarantine.load_quarantine(path)[key]
+    assert merged == {"crashes": 2,
+                      "classes": ["worker_oom", "worker_segv"],
+                      "quarantined": True}
+
+
+def test_quarantine_load_tolerates_garbage(tmp_path):
+    path = tmp_path / "q.json"
+    path.write_text("{not json")
+    assert quarantine.load_quarantine(str(path)) == {}
+    path.write_text(json.dumps({"version": 999, "contracts": {"k": {}}}))
+    assert quarantine.load_quarantine(str(path)) == {}
+    assert quarantine.load_quarantine(str(tmp_path / "absent.json")) == {}
+
+
+def test_pathless_store_still_counts_in_memory():
+    store = quarantine.QuarantineStore(None, threshold=1)
+    key = quarantine.contract_key("0xbeef")
+    assert store.record_crash(key, resilience.WORKER_SEGV) is True
+    assert store.is_quarantined(key)
+    assert store.status()["sidecar"] is None
+
+
+# -- satellite 1: one deadline parser with a declared clamp ---------------------------
+
+
+def test_execution_timeout_respects_deadline():
+    assert execution_timeout_s(5000) == 5.0
+    assert execution_timeout_s(1) == 0.001
+
+
+def test_execution_timeout_default_is_knob_ceiling():
+    assert execution_timeout_s(None) == 86400.0
+    assert execution_timeout_s(0) == 86400.0
+
+
+def test_execution_timeout_clamps_to_knob(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_SERVE_MAX_DEADLINE_MS", "10000")
+    assert execution_timeout_s(999_999_999) == 10.0
+    assert execution_timeout_s(2000) == 2.0
+    assert execution_timeout_s(None) == 10.0
+
+
+# -- supervisor + fake worker ---------------------------------------------------------
+
+
+def test_supervisor_runs_job_and_folds_metrics(tmp_path):
+    sup = _supervisor(tmp_path)
+    try:
+        sup.start()
+        payload = sup.run_job({"code": "0x6001"}, cid="cid-1")
+        assert payload["issue_count"] == 0
+        assert payload["retry"] is False
+        # the worker's serve_metrics deltas fold into daemon counters
+        assert metrics.value("xla.bucket_compiles") == 1
+        assert metrics.value("xla.bucket_reuses") == 2
+        assert metrics.value("serve.worker.spawns") == 1
+        status = sup.status()
+        assert status["live"] == 1
+        assert status["workers"][0]["jobs_done"] == 1
+    finally:
+        sup.stop()
+
+
+def test_injected_segv_retries_once_on_fresh_worker(tmp_path):
+    sup = _supervisor(tmp_path, inject_fault="worker_segv:1")
+    try:
+        sup.start()
+        first_pid = sup.status()["workers"][0]["pid"]
+        payload = sup.run_job({"code": "0xdead01"}, cid="cid-2")
+        # answered by the retry: ladder fallback on a *new* worker
+        assert payload["retry"] is True
+        assert payload["ladder"] is True
+        assert payload["pid"] != first_pid
+        assert metrics.value("serve.worker.retries") == 1
+        assert metrics.value("serve.worker.restarts") == 1
+        deaths = metrics.snapshot()["serve.worker.deaths"]
+        assert deaths["worker_segv"]["count"] == 1
+        # one crash charged, but below the threshold: not quarantined
+        assert sup.quarantine.status() == {
+            "sidecar": sup.quarantine.path, "threshold": 2,
+            "tracked": 1, "quarantined": 0}
+        status = sup.status()
+        assert status["deaths"] == 1 and status["restarts"] == 1
+    finally:
+        sup.stop()
+
+
+def test_double_death_quarantines_and_refuses(tmp_path):
+    sup = _supervisor(tmp_path,
+                      inject_fault="worker_segv:1,worker_segv:2")
+    try:
+        sup.start()
+        with pytest.raises(resilience.WorkerSegv):
+            sup.run_job({"code": "0xdead02"}, cid="cid-3")
+        assert metrics.value("serve.worker.quarantined") == 1
+        with pytest.raises(quarantine.QuarantinedContract):
+            sup.run_job({"code": "0xdead02"}, cid="cid-4")
+        assert metrics.value("serve.worker.quarantine_refusals") == 1
+        # the verdict is on disk for the next daemon
+        doc = quarantine.load_quarantine(sup.quarantine.path)
+        entry = doc[quarantine.contract_key("0xdead02")]
+        assert entry["quarantined"] and entry["crashes"] == 2
+        # an innocent contract is still served
+        assert sup.run_job({"code": "0x6002"})["issue_count"] == 0
+    finally:
+        sup.stop()
+
+
+def test_oom_kill_classifies_worker_oom(tmp_path):
+    sup = _supervisor(tmp_path, inject_fault="worker_oom:1")
+    try:
+        sup.start()
+        payload = sup.run_job({"code": "0xdead03"})
+        assert payload["retry"] is True
+        assert metrics.snapshot()["serve.worker.deaths"][
+            "worker_oom"]["count"] == 1
+    finally:
+        sup.stop()
+
+
+def test_silent_worker_is_killed_as_hang(tmp_path):
+    sup = _supervisor(tmp_path, heartbeat_ms=400,
+                      inject_fault="worker_hang:1")
+    try:
+        sup.start()
+        payload = sup.run_job({"code": "0xdead04"})
+        assert payload["retry"] is True
+        assert metrics.snapshot()["serve.worker.deaths"][
+            "worker_hang"]["count"] == 1
+    finally:
+        sup.stop()
+
+
+def test_heartbeats_keep_slow_worker_alive(tmp_path):
+    # the job outlives the heartbeat window, but each beat resets the
+    # deadline — slow must never classify as hung
+    sup = _supervisor(tmp_path, heartbeat_ms=600)
+    try:
+        sup.start()
+        payload = sup.run_job({"code": "0x6003", "fake": "slow",
+                               "beats": 5, "beat_s": 0.25})
+        assert payload["issue_count"] == 0
+        assert metrics.value("serve.worker.retries") == 0
+    finally:
+        sup.stop()
+
+
+def test_clean_in_worker_error_is_not_retried(tmp_path):
+    sup = _supervisor(tmp_path)
+    try:
+        sup.start()
+        with pytest.raises(WorkerAnalysisError) as err:
+            sup.run_job({"code": "0x6004", "fake": "clean_error"})
+        assert err.value.error_type == "ValueError"
+        assert metrics.value("serve.worker.retries") == 0
+        assert sup.status()["deaths"] == 0
+        # the sandbox survived and serves the next job
+        assert sup.run_job({"code": "0x6005"})["issue_count"] == 0
+    finally:
+        sup.stop()
+
+
+def test_plain_exit_classifies_worker_crash(tmp_path):
+    sup = _supervisor(tmp_path)
+    try:
+        sup.start()
+        payload = sup.run_job({"code": "0x6006", "fake": "exit3"})
+        # retried with the normal path (the fake's behavior key rides
+        # params, so the retry exits too... unless): exit3 happens both
+        # times -> double death -> typed crash
+    except resilience.DeviceWorkerCrash:
+        deaths = metrics.snapshot()["serve.worker.deaths"]
+        assert deaths["worker_crash"]["count"] == 2
+    else:
+        pytest.fail(f"expected DeviceWorkerCrash, got {payload}")
+    finally:
+        sup.stop()
+
+
+def test_run_fleet_demuxes_member_outcomes(tmp_path):
+    sup = _supervisor(tmp_path)
+    try:
+        sup.start()
+        outcomes = sup.run_fleet([{"code": "0x01"}, {"code": "0x02"}])
+        assert [o["payload"]["member"] for o in outcomes] == [0, 1]
+    finally:
+        sup.stop()
+
+
+def test_fleet_death_retries_without_charging_co_members(tmp_path):
+    sup = _supervisor(tmp_path, inject_fault="worker_segv:1")
+    try:
+        sup.start()
+        outcomes = sup.run_fleet([{"code": "0x01"}, {"code": "0x02"}])
+        assert all(o["ok"] for o in outcomes)
+        assert all(o["payload"]["ladder"] for o in outcomes)
+        # nobody is charged for a shared batch's death
+        assert sup.quarantine.status()["tracked"] == 0
+    finally:
+        sup.stop()
+
+
+# -- service-level integration (worker mode) ------------------------------------------
+
+
+def _worker_service(tmp_path, monkeypatch, **overrides):
+    monkeypatch.setattr(Supervisor, "_worker_command",
+                        lambda self: list(FAKE_WORKER))
+    defaults = dict(manifest_path=str(tmp_path / "warmset.json"),
+                    warmup=False, max_inflight=2, workers=1)
+    defaults.update(overrides)
+    return AnalysisService(**defaults)
+
+
+def test_service_routes_analyze_through_pool(tmp_path, monkeypatch):
+    service = _worker_service(tmp_path, monkeypatch)
+    service.startup()
+    try:
+        reply = service.handle(protocol.parse_request(json.dumps(
+            {"op": "analyze", "id": "w1", "code": "0x6001600055"})))
+        assert reply["ok"] and reply["issue_count"] == 0
+        assert reply["correlation_id"]
+        healthz = service.handle(
+            protocol.parse_request('{"op": "healthz", "id": "h"}'))
+        assert healthz["workers"]["pool"] == 1
+        assert healthz["workers"]["live"] == 1
+        assert healthz["workers"]["quarantine"]["quarantined"] == 0
+    finally:
+        service.shutdown()
+
+
+def test_service_answers_quarantined_error(tmp_path, monkeypatch):
+    service = _worker_service(tmp_path, monkeypatch,
+                              inject_fault="worker_segv:1,worker_segv:2")
+    service.startup()
+    try:
+        first = service.handle(protocol.parse_request(json.dumps(
+            {"op": "analyze", "id": "w2", "code": "0x6001600055"})))
+        assert not first["ok"]
+        assert first["error"]["code"] == "analysis_failed"
+        second = service.handle(protocol.parse_request(json.dumps(
+            {"op": "analyze", "id": "w3", "code": "0x6001600055"})))
+        assert not second["ok"]
+        assert second["error"]["code"] == "quarantined"
+        assert "quarantined" in second["error"]["message"]
+        healthz = service.handle(
+            protocol.parse_request('{"op": "healthz", "id": "h"}'))
+        assert healthz["workers"]["quarantine"]["quarantined"] == 1
+    finally:
+        service.shutdown()
+
+
+def test_legacy_service_reports_no_pool(tmp_path):
+    service = AnalysisService(manifest_path=None, warmup=False,
+                              max_inflight=2)
+    healthz = service.handle(
+        protocol.parse_request('{"op": "healthz", "id": "h"}'))
+    assert healthz["workers"] is None
+
+
+# -- satellite 3: concurrent daemon starts on one stale socket ------------------------
+
+
+def test_concurrent_starts_reclaim_stale_socket_exactly_once(tmp_path):
+    path = str(tmp_path / "serve.sock")
+    stale = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    stale.bind(path)
+    stale.close()  # bound but never listening: a crashed daemon's husk
+
+    services = [AnalysisService(manifest_path=None, warmup=False,
+                                max_inflight=2) for _ in range(2)]
+    for service in services:
+        service._run_analysis = lambda params: {
+            "issue_count": 0, "incomplete": False, "coverage": {},
+            "report": {"issues": []}}
+    readies = [threading.Event(), threading.Event()]
+    outcomes = [None, None]
+    barrier = threading.Barrier(2)
+
+    def run(index):
+        try:
+            barrier.wait()
+            daemon.serve_socket(services[index], socket_path=path,
+                                ready_event=readies[index])
+            outcomes[index] = "served"
+        except RuntimeError:
+            outcomes[index] = "refused"
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(2)]
+    for thread in threads:
+        thread.start()
+    # exactly one daemon must win the reclaim and come up
+    winner = None
+    for _ in range(100):
+        for index, ready in enumerate(readies):
+            if ready.wait(0.1):
+                winner = index
+                break
+        if winner is not None:
+            break
+    assert winner is not None, f"no daemon came up: {outcomes}"
+    reply = serve_client.request({"op": "ping"}, socket_path=path,
+                                 timeout=10)
+    assert reply["ok"]
+    serve_client.request({"op": "shutdown"}, socket_path=path, timeout=10)
+    for thread in threads:
+        thread.join(timeout=10)
+    assert sorted(str(o) for o in outcomes) == ["refused", "served"]

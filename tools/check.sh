@@ -53,6 +53,11 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu \
     python -m tools.serve_smoke || exit $?
 
 echo
+echo "== chaos smoke (worker segv/hang injection -> retry -> quarantine) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m tools.chaos_smoke || exit $?
+
+echo
 echo "== tier-1 (pytest, not slow, 870s budget) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

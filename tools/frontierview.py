@@ -309,6 +309,10 @@ def metrics_report(snapshot: Dict[str, object]) -> str:
         snapshot, "frontier.merge.",
         "no merge passes ran — state merging off or no reconverged "
         "lanes"))
+    lines.append("")
+    lines.extend(_metrics_slice(
+        snapshot, "serve.worker.",
+        "no worker pool — serve ran without --workers"))
     return "\n".join(lines)
 
 

@@ -209,7 +209,7 @@ def report(events: List[dict], other: Dict[str, object]) -> str:
         lines.append("  (no xla.compile spans — every bucket was warm)")
 
     lines.extend(_staticanalysis_section(spans))
-    lines.extend(_serve_section(spans))
+    lines.extend(_serve_section(spans, instants))
 
     if instants:
         lines.append("")
@@ -240,17 +240,21 @@ def _staticanalysis_section(spans: List[dict]) -> List[str]:
     return lines
 
 
-def _serve_section(spans: List[dict]) -> List[str]:
+def _serve_section(spans: List[dict],
+                   instants: Optional[List[dict]] = None) -> List[str]:
     """Serve-daemon rollup: warmup attributed separately from request
     time, then one line per request (id, duration, warm vs cold dispatch
     counts) with its per-phase breakdown — spans that ran inside the
-    request window, grouped by category. Empty (section omitted) for
-    traces without serve spans, so non-serve reports are unchanged."""
+    request window, grouped by category — and, for worker-pool daemons,
+    the worker lifecycle (ready/death/quarantine instants). Empty
+    (section omitted) for traces without serve spans, so non-serve
+    reports are unchanged."""
     warmups = [s for s in spans if s["name"] == "serve.warmup"]
     requests = [s for s in spans if s["name"] == "serve.request"]
     if not warmups and not requests:
         return []
     lines = ["", "== serve (warmup vs requests) =="]
+    lines.extend(_worker_lifecycle(instants or []))
     for span in warmups:
         args = span.get("args", {})
         line = (f"  warmup: {_fmt_us(float(span.get('dur', 0.0)))} — "
@@ -297,6 +301,40 @@ def _serve_section(spans: List[dict]) -> List[str]:
                 f"    [{share:5.1f}%] {row['name']:<12} "
                 f"total {_fmt_us(row['total_us']):>9}  "
                 f"x{row['count']:<6} mean {_fmt_us(row['mean_us']):>9}")
+    return lines
+
+
+def _worker_lifecycle(instants: List[dict]) -> List[str]:
+    """Worker-pool lifecycle rollup from the supervisor's trace
+    instants: spawn/ready count, deaths grouped by failure class, and
+    quarantine additions. Empty for daemons without a pool."""
+    ready = [e for e in instants if e.get("name") == "serve.worker.ready"]
+    deaths = [e for e in instants if e.get("name") == "serve.worker.death"]
+    poisoned = [e for e in instants
+                if e.get("name") == "serve.quarantine.added"]
+    if not ready and not deaths and not poisoned:
+        return []
+    lines = [f"  worker pool: {len(ready)} ready event(s), "
+             f"{len(deaths)} death(s), {len(poisoned)} contract(s) "
+             f"quarantined"]
+    by_class: Dict[str, int] = defaultdict(int)
+    for event in deaths:
+        by_class[str((event.get("args") or {}).get("failure_class",
+                                                   "?"))] += 1
+    for failure_class in sorted(by_class):
+        lines.append(f"    death class {failure_class:<14} "
+                     f"x{by_class[failure_class]}")
+    for event in sorted(deaths, key=lambda e: float(e.get("ts", 0.0))):
+        args = event.get("args") or {}
+        lines.append(
+            f"    @{_fmt_us(float(event.get('ts', 0.0))):>9}  slot "
+            f"{args.get('slot', '?')} died: "
+            f"{args.get('failure_class', '?')}"
+            + (f" ({args.get('detail')})" if args.get("detail") else ""))
+    for event in poisoned:
+        args = event.get("args") or {}
+        lines.append(f"    quarantined contract "
+                     f"{args.get('contract', '?')}…")
     return lines
 
 
