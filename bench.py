@@ -37,6 +37,7 @@ single end-of-run print lost every measurement):
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -229,6 +230,54 @@ def _solver_latency():
             for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"))}
 
 
+def _warm_start_ab():
+    """Cold-vs-warm worker spawn A/B (README "Durable warmth"): one
+    child process seeds a private warmset manifest + executable cache +
+    verdict sidecar, then two fresh interpreters time manifest warmup —
+    one against an EMPTY executable cache and a fresh XLA cache (the
+    pre-durable-warmth respawn: every bucket pays its compile) and one
+    against the seeded stores (deserialize-only). The child phases are
+    tools/warm_smoke.py's (the check.sh gate), so the bench number and
+    the gate measure the same code path."""
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="bench_warm_start_")
+    manifest = os.path.join(workdir, "warmset.json")
+
+    def child(phase, exec_dir, xla_dir):
+        env = dict(os.environ,
+                   MYTHRIL_TPU_SERVE_MANIFEST=manifest,
+                   MYTHRIL_TPU_EXEC_CACHE_DIR=exec_dir,
+                   MYTHRIL_TPU_JAX_CACHE=xla_dir)
+        result = subprocess.run(
+            [sys.executable, "-m", "tools.warm_smoke", "--phase", phase,
+             "--manifest", manifest],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=600)
+        if result.returncode != 0:
+            raise RuntimeError(
+                f"warm_start {phase} child failed (rc={result.returncode}): "
+                f"{result.stderr.strip()[-500:]}")
+        return json.loads(result.stdout.strip().splitlines()[-1])
+
+    seeded_exec = os.path.join(workdir, "exec_cache")
+    seeded_xla = os.path.join(workdir, "xla_warm")
+    child("cold", seeded_exec, seeded_xla)
+    cold = child("ready", os.path.join(workdir, "exec_cache_empty"),
+                 os.path.join(workdir, "xla_cold"))
+    warm = child("ready", seeded_exec, seeded_xla)
+    return {
+        "cold_ready_s": cold["ready_s"],
+        "cold_compiles": cold["compiles"],
+        "warm_ready_s": warm["ready_s"],
+        "warm_compiles": warm["compiles"],
+        "warm_exec_hits": warm["exec_hits"],
+        "verdicts_loaded": warm["verdicts_loaded"],
+        "spawn_speedup": round(cold["ready_s"]
+                               / max(warm["ready_s"], 1e-9), 2),
+    }
+
+
 def main():
     seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 45.0
     import jax
@@ -397,6 +446,19 @@ def main():
            flush_occupancy_ratio=fleet_ab["flush_occupancy_ratio"],
            shared_flushes=fleet_flush["shared_flushes"])
 
+    # 3d. durable-warmth A/B (README "Durable warmth"): cold vs warm
+    #     worker spawn-to-ready, in child interpreters so the parent's
+    #     warm jit caches cannot leak into the "cold" side. Best-effort:
+    #     a failed child degrades to an error note, not a dead bench.
+    try:
+        with trace.span("bench.warm_start"):
+            warm_start_ab = _warm_start_ab()
+        _phase("warm_start", **warm_start_ab)
+    except (RuntimeError, OSError, ValueError, KeyError,
+            subprocess.TimeoutExpired) as error:
+        warm_start_ab = {"error": str(error)[:500]}
+        _phase("warm_start", error=warm_start_ab["error"])
+
     if tpu_info["forks_on_device"] > 0 and tpu_rate > host_rate:
         trace.export()
         metrics.write_snapshot(metrics_path)
@@ -413,6 +475,7 @@ def main():
             "host": host_info,
             "merge_ab": merge_ab,
             "fleet_ab": fleet_ab,
+            "warm_start": warm_start_ab,
             "frontier": _frontier_rollup(),
         "solver_latency_ms": _solver_latency(),
             "corpus": _corpus_extras(),
@@ -445,6 +508,7 @@ def main():
         "sym_host": host_info,
         "merge_ab": merge_ab,
         "fleet_ab": fleet_ab,
+        "warm_start": warm_start_ab,
         "frontier": _frontier_rollup(),
         "solver_latency_ms": _solver_latency(),
         "corpus": _corpus_extras(),

@@ -118,6 +118,25 @@ _METRICS: List[MetricSpec] = [
                "bucket (pays XLA compile or persistent-cache load)."),
     MetricSpec("xla.bucket_reuses", COUNTER, "1",
                "Solver runner invocations on an already-compiled bucket."),
+    # -- durable warmth caches (parallel/exec_cache.py, serve/warmset.py) --------
+    MetricSpec("cache.exec.hits", COUNTER, "1",
+               "Shape buckets warmed by deserializing a persisted "
+               "executable instead of compiling."),
+    MetricSpec("cache.exec.misses", COUNTER, "1",
+               "Shape buckets that compiled because no usable persisted "
+               "executable existed (then serialized for next spawn)."),
+    MetricSpec("cache.exec.deserialize_ms", HISTOGRAM, "ms",
+               "Wall time to load + deserialize one persisted solver "
+               "executable."),
+    MetricSpec("cache.verdict.loaded", COUNTER, "1",
+               "Verdict-cache entries loaded from the persisted sidecar "
+               "at spawn/warmup."),
+    MetricSpec("cache.verdict.merged", COUNTER, "1",
+               "In-memory verdicts union-merged into the sidecar at "
+               "save time."),
+    MetricSpec("cache.verdict.evicted", COUNTER, "1",
+               "Sidecar verdict entries evicted by the "
+               "MYTHRIL_TPU_VERDICT_SIDECAR_MAX bound."),
     # -- device frontier (parallel/frontier.py) ----------------------------------
     MetricSpec("frontier.chunks", COUNTER, "1",
                "Fused lockstep chunks dispatched to the device."),

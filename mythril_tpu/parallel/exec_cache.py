@@ -1,0 +1,245 @@
+"""Persistent executable cache: solver runners as files, not compiles.
+
+BENCH_r05 measured the cold path at ~112 s of XLA compilation before the
+first useful device step, and the serve worker pool (PR 15) pays it *per
+spawned worker*. The warmset manifest already tells a fresh process WHAT
+to warm (the shape keys); this module makes the warming itself a cache
+read: every compiled solver runner is serialized with JAX's AOT
+machinery (``jax.experimental.serialize_executable``) into a content-
+addressed file, and the next process deserializes it instead of
+compiling — the DTVM deterministic-JIT argument (PAPERS.md) applied to
+the solver tier.
+
+Cache key (one file per entry, filename = sha256 of the key JSON):
+
+* jax + jaxlib versions — serialized executables are not ABI-stable
+  across releases;
+* device platform + device kind — an executable compiled for one
+  accelerator is garbage on another;
+* the runner shape key (``jax_solver._run_accounted``'s bucket key) —
+  kind, chunk, forced depth, and every padded dimension;
+* a program fingerprint (sha256 of ``jax_solver.py``'s source plus
+  :data:`SCHEMA_VERSION`) — editing the kernel invalidates every entry
+  without any manual versioning.
+
+Only single-device runners are cached (``single`` with ``n_devices ==
+1`` and every ``batch`` key): sharded executables embed mesh/topology
+state that does not survive a process boundary, so those keys fall back
+to ordinary compilation (which still hits the persistent *XLA* cache
+enabled in ``parallel/__init__``).
+
+Writes are fsync-atomic (tmp + fsync + rename via
+``support/checkpoint.fsync_replace``) beside the warmset manifest, and
+loads are corruption-tolerant: a truncated, garbled, wrong-schema, or
+wrong-version file silently degrades to a compile — never a crash.
+Hit/miss/latency land in the ``cache.exec.*`` metrics.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import time
+from typing import Optional, Tuple
+
+from ..observe import metrics
+from ..support import tpu_config
+from ..support.checkpoint import fsync_replace
+
+log = logging.getLogger(__name__)
+
+#: bump to invalidate every persisted executable (folded into both the
+#: entry filename and the payload header, so old files are simply never
+#: found and a hash collision still fails the header check)
+SCHEMA_VERSION = 1
+
+#: pickled payloads beyond this size are refused at load time — a
+#: corrupt length field must not balloon into an allocation bomb
+MAX_ENTRY_BYTES = 1 << 30
+
+
+def enabled() -> bool:
+    """MYTHRIL_TPU_EXEC_CACHE (default on)."""
+    return tpu_config.get_flag("MYTHRIL_TPU_EXEC_CACHE")
+
+
+def cache_dir() -> str:
+    """MYTHRIL_TPU_EXEC_CACHE_DIR, or an ``exec_cache/`` directory
+    beside the warmset manifest (so the executable store, the shape
+    manifest, and the verdict/summary/quarantine sidecars travel
+    together)."""
+    configured = tpu_config.get_str("MYTHRIL_TPU_EXEC_CACHE_DIR")
+    if configured:
+        return configured
+    from ..serve.warmset import default_manifest_path
+
+    return os.path.join(os.path.dirname(default_manifest_path()),
+                        "exec_cache")
+
+
+def cacheable(shape_key: Tuple) -> bool:
+    """Only single-device runners serialize portably: ``batch`` keys and
+    ``single`` keys with ``n_devices == 1``. Sharded runners embed mesh
+    state and fall back to ordinary compilation."""
+    try:
+        if shape_key[0] == "batch":
+            return True
+        return shape_key[0] == "single" and shape_key[1] == 1
+    except (IndexError, TypeError):
+        return False
+
+
+_FINGERPRINT: Optional[str] = None
+
+
+def program_fingerprint() -> str:
+    """sha256 of the solver kernel source + schema version: any edit to
+    ``jax_solver.py`` orphans every persisted executable."""
+    global _FINGERPRINT
+    if _FINGERPRINT is None:
+        from . import jax_solver
+
+        digest = hashlib.sha256()
+        digest.update(f"schema:{SCHEMA_VERSION}".encode("utf-8"))
+        try:
+            with open(jax_solver.__file__, "rb") as handle:
+                digest.update(handle.read())
+        except OSError:
+            digest.update(b"source-unavailable")
+        _FINGERPRINT = digest.hexdigest()
+    return _FINGERPRINT
+
+
+def _backend_tag() -> str:
+    import jax
+
+    device = jax.devices()[0]
+    jaxlib_version = ""
+    try:
+        import jaxlib.version
+
+        jaxlib_version = jaxlib.version.__version__
+    except ImportError:
+        pass
+    return json.dumps([jax.__version__, jaxlib_version, device.platform,
+                       getattr(device, "device_kind", "")])
+
+
+def entry_key(shape_key: Tuple) -> str:
+    """The full cache key, JSON-shaped (hashed into the filename AND
+    stored in the payload header for a post-load equality check)."""
+    return json.dumps([SCHEMA_VERSION, _backend_tag(),
+                       program_fingerprint(), list(shape_key)],
+                      default=str)
+
+
+def entry_path(shape_key: Tuple) -> str:
+    digest = hashlib.sha256(entry_key(shape_key).encode("utf-8"))
+    return os.path.join(cache_dir(), f"{digest.hexdigest()}.jexec")
+
+
+def store(shape_key: Tuple, compiled) -> bool:
+    """Serialize one ``jax.stages.Compiled`` runner fsync-atomically.
+    Best-effort: any failure (unserializable executable, full disk,
+    read-only cache dir) logs and returns False — persistence is an
+    optimization, never a gate on the solve that just happened."""
+    if not enabled() or not cacheable(shape_key):
+        return False
+    try:
+        from jax.experimental import serialize_executable
+
+        payload, in_tree, out_tree = serialize_executable.serialize(
+            compiled)
+        blob = pickle.dumps({"key": entry_key(shape_key),
+                             "payload": payload,
+                             "in_tree": in_tree,
+                             "out_tree": out_tree},
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        path = entry_path(shape_key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as handle:
+            handle.write(blob)
+        fsync_replace(tmp, path)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as error:
+        log.warning("could not persist executable for %s: %r",
+                    shape_key, error)
+        return False
+    log.info("persisted executable for %s (%d bytes)", shape_key,
+             len(blob))
+    return True
+
+
+def load(shape_key: Tuple):
+    """Deserialize the persisted runner for a shape key, or None.
+
+    Counts ``cache.exec.hits`` + ``cache.exec.deserialize_ms`` on
+    success and ``cache.exec.misses`` on any enabled-but-unusable
+    outcome (absent, truncated, garbage, schema/version/fingerprint
+    mismatch, deserialization failure) — the caller falls back to
+    compiling, which re-persists a fresh entry."""
+    if not enabled() or not cacheable(shape_key):
+        return None
+    path = entry_path(shape_key)
+    started = time.perf_counter()
+    try:
+        if os.path.getsize(path) > MAX_ENTRY_BYTES:
+            raise ValueError("entry exceeds MAX_ENTRY_BYTES")
+        with open(path, "rb") as handle:
+            doc = pickle.loads(handle.read())
+        if not isinstance(doc, dict) or doc.get("key") != \
+                entry_key(shape_key):
+            raise ValueError("cache key mismatch")
+        from jax.experimental import serialize_executable
+
+        compiled = serialize_executable.deserialize_and_load(
+            doc["payload"], doc["in_tree"], doc["out_tree"])
+    except FileNotFoundError:
+        metrics.inc("cache.exec.misses")
+        return None
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as error:
+        # corruption-tolerant by contract: a torn or stale entry is a
+        # compile, never a crash
+        log.warning("unusable persisted executable for %s at %s: %r — "
+                    "falling back to compile", shape_key, path, error)
+        metrics.inc("cache.exec.misses")
+        return None
+    elapsed_ms = (time.perf_counter() - started) * 1000.0
+    metrics.inc("cache.exec.hits")
+    metrics.observe("cache.exec.deserialize_ms", elapsed_ms)
+    log.info("deserialized executable for %s in %.1f ms", shape_key,
+             elapsed_ms)
+    return compiled
+
+
+def compile_and_store(runner, shape_key: Tuple, args: Tuple):
+    """AOT-compile `runner` for `args` via lower().compile(), persist
+    the executable, and return the ``Compiled`` — or None when the key
+    is uncacheable or AOT lowering fails (the caller then runs the
+    plain jitted path; with the persistent XLA cache on, the backend
+    compile below is shared either way)."""
+    if not enabled() or not cacheable(shape_key):
+        return None
+    try:
+        compiled = runner.lower(*args).compile()
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as error:
+        log.warning("AOT compile failed for %s: %r — using the jit "
+                    "path", shape_key, error)
+        return None
+    store(shape_key, compiled)
+    return compiled
+
+
+def stats() -> dict:
+    """Current hit/miss counters (serve ready events and /healthz)."""
+    return {"hits": int(metrics.value("cache.exec.hits")),
+            "misses": int(metrics.value("cache.exec.misses"))}

@@ -44,12 +44,15 @@ smt/solver/solver.py exactly like a CDCL model.
 
 from __future__ import annotations
 
+import logging
 from functools import lru_cache
 from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 from ..observe import metrics, trace
+
+log = logging.getLogger(__name__)
 
 SAT, UNSAT, UNKNOWN = 1, 0, -1
 
@@ -77,19 +80,66 @@ _UNASSIGNED, _TRUE, _FALSE = 0, 1, 2
 #: argument shape, not when lru_cache builds the jitted callable
 _SHAPES_RUN: set = set()
 
+#: shape key -> AOT ``jax.stages.Compiled`` executable, either
+#: deserialized from the persistent executable cache (exec_cache.py) or
+#: compiled here and persisted for the next process. Preferred over the
+#: jitted runner at every invocation, so a deserialize-first warm worker
+#: never touches the jit compile path at all.
+_AOT_EXECUTABLES: dict = {}
+
 
 def _run_accounted(runner, shape_key, state, lits, valid, order):
-    """One runner invocation with XLA compile accounting: the first call
-    per (runner kind, arg-shape) key pays compilation or a persistent-cache
-    load, so it gets an ``xla.compile`` span (traceview attributes the
-    latency cliff to its clause-shape bucket); later calls count as bucket
-    reuses."""
+    """One runner invocation with XLA compile accounting.
+
+    The first call per (runner kind, arg-shape) key consults the
+    persistent executable cache: a deserialize hit counts
+    ``cache.exec.hits`` + ``xla.bucket_reuses`` (warmth was durable — no
+    compile happened); a miss pays an AOT compile under an
+    ``xla.compile`` span (traceview attributes the latency cliff to its
+    clause-shape bucket), then persists the executable so the NEXT
+    process's first call is a cache read. Later calls reuse the AOT
+    executable (or the jit cache for uncacheable sharded keys) and count
+    as bucket reuses."""
     if shape_key in _SHAPES_RUN:
         metrics.inc("xla.bucket_reuses")
+        aot = _AOT_EXECUTABLES.get(shape_key)
+        if aot is not None:
+            try:
+                return aot(state, lits, valid, order)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                # arg-layout drift (e.g. weak-type mismatch with a
+                # deserialized executable): drop it and let jit recover
+                log.warning("AOT executable rejected args for %s — "
+                            "reverting to the jit path", shape_key)
+                _AOT_EXECUTABLES.pop(shape_key, None)
         return runner(state, lits, valid, order)
+
+    from . import exec_cache
+
+    loaded = exec_cache.load(shape_key)
+    if loaded is not None:
+        try:
+            result = loaded(state, lits, valid, order)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            log.warning("deserialized executable rejected args for %s — "
+                        "compiling instead", shape_key)
+        else:
+            _SHAPES_RUN.add(shape_key)
+            _AOT_EXECUTABLES[shape_key] = loaded
+            metrics.inc("xla.bucket_reuses")
+            return result
     _SHAPES_RUN.add(shape_key)
     metrics.inc("xla.bucket_compiles")
     with trace.span("xla.compile", shape=str(shape_key)):
+        compiled = exec_cache.compile_and_store(
+            runner, shape_key, (state, lits, valid, order))
+        if compiled is not None:
+            _AOT_EXECUTABLES[shape_key] = compiled
+            return compiled(state, lits, valid, order)
         return runner(state, lits, valid, order)
 
 
@@ -594,18 +644,17 @@ def observed_shape_keys() -> List[tuple]:
 
 
 def warm_shape_key(key) -> bool:
-    """Pre-compile one runner shape bucket by invoking it once on a
-    synthetic zero-clause problem of exactly that padded shape.
+    """Warm one runner shape bucket: deserialize-first, compile-on-miss.
 
-    Calling the jitted runner (rather than ``.lower().compile()`` alone)
-    is deliberate: the AOT path produces a compiled object but leaves the
-    call-site jit cache cold, so the first real query would still pay
-    tracing plus a persistent-cache load. One throwaway invocation puts
-    the executable in the exact cache real queries hit, and routes through
-    ``_run_accounted`` so the compile is attributed to the warmup span,
-    not the first request. Returns False (never raises) for malformed
-    keys, out-of-bounds shapes, or sharded keys the current mesh cannot
-    host — a stale manifest must not take the daemon down."""
+    The synthetic zero-clause problem below has exactly the bucket's
+    padded shapes/dtypes, and the invocation routes through
+    ``_run_accounted`` — so a persisted executable is deserialized into
+    ``_AOT_EXECUTABLES`` (the cache real queries hit first) and a miss
+    pays its AOT compile inside the warmup span, not the first request,
+    then persists the executable for the next spawn. Returns False
+    (never raises) for malformed keys, out-of-bounds shapes, or sharded
+    keys the current mesh cannot host — a stale manifest must not take
+    the daemon down."""
     import jax
     import jax.numpy as jnp
 

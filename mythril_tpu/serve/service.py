@@ -426,6 +426,10 @@ class AnalysisService:
             request_errors=int(metrics.value("serve.request_errors")),
             warm={"cold_buckets": int(metrics.value("xla.bucket_compiles")),
                   "warm_hits": int(metrics.value("xla.bucket_reuses")),
+                  "exec_hits": int(metrics.value("cache.exec.hits")),
+                  "exec_misses": int(metrics.value("cache.exec.misses")),
+                  "verdicts_loaded":
+                      int(metrics.value("cache.verdict.loaded")),
                   "warmset": self.warmset.status()},
             frontier=_frontier_counters(),
             workers=(self._supervisor.status()
@@ -467,6 +471,8 @@ class AnalysisService:
         started = time.monotonic()
         cold_before = metrics.value("xla.bucket_compiles")
         warm_before = metrics.value("xla.bucket_reuses")
+        exec_hits_before = metrics.value("cache.exec.hits")
+        exec_misses_before = metrics.value("cache.exec.misses")
         frontier_before = _frontier_counters()
         with trace.span("serve.request", request_id=str(request.id),
                         correlation_id=cid) as span:
@@ -503,9 +509,13 @@ class AnalysisService:
                 return reply
             cold = metrics.value("xla.bucket_compiles") - cold_before
             warm = metrics.value("xla.bucket_reuses") - warm_before
+            exec_hits = metrics.value("cache.exec.hits") - exec_hits_before
+            exec_misses = \
+                metrics.value("cache.exec.misses") - exec_misses_before
             frontier = {name: value - frontier_before[name]
                         for name, value in _frontier_counters().items()}
             span.set(cold_buckets=cold, warm_hits=warm,
+                     exec_hits=exec_hits, exec_misses=exec_misses,
                      issues=payload["issue_count"],
                      frontier_executed=frontier["executed"],
                      frontier_forks=frontier["forks"])
@@ -521,12 +531,14 @@ class AnalysisService:
         slog.event("serve.reply", request_id=str(request.id), ok=True,
                    issues=payload["issue_count"],
                    elapsed_ms=round(elapsed_ms, 3),
-                   cold_buckets=cold, warm_hits=warm)
+                   cold_buckets=cold, warm_hits=warm,
+                   exec_hits=exec_hits, exec_misses=exec_misses)
         return protocol.ok_reply(
             request.id,
             correlation_id=cid,
             elapsed_ms=round(elapsed_ms, 3),
-            warm={"cold_buckets": cold, "warm_hits": warm},
+            warm={"cold_buckets": cold, "warm_hits": warm,
+                  "exec_hits": exec_hits, "exec_misses": exec_misses},
             frontier=frontier,
             **payload)
 

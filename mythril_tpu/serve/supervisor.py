@@ -354,10 +354,25 @@ class Supervisor:
                 continue
             if msg.get("event") == "ready":
                 log.info("worker slot %d ready: pid %s, %s warm "
-                         "bucket(s)", handle.slot, proc.pid,
-                         msg.get("warmed", 0))
+                         "bucket(s), %s executable-cache hit(s), %s "
+                         "verdict(s) loaded", handle.slot, proc.pid,
+                         msg.get("warmed", 0), msg.get("exec_hits", 0),
+                         msg.get("verdicts_loaded", 0))
+                self._fold_ready_metrics(msg)
                 return True
         return False
+
+    def _fold_ready_metrics(self, msg: dict) -> None:
+        """Fold one worker's pre-warm deltas (shipped on its ready
+        event) into the daemon's durable-warmth counters, so /healthz
+        and /metrics report pool-wide deserialize-vs-compile coverage."""
+        for name, value in (("cache.exec.hits", msg.get("exec_hits")),
+                            ("cache.exec.misses",
+                             msg.get("exec_misses")),
+                            ("cache.verdict.loaded",
+                             msg.get("verdicts_loaded"))):
+            if isinstance(value, int) and value > 0:
+                metrics.inc(name, value)
 
     def _live_count(self) -> int:
         with self._lock:
@@ -605,7 +620,11 @@ class Supervisor:
         for name, value in (("xla.bucket_compiles",
                              deltas.get("cold_buckets")),
                             ("xla.bucket_reuses",
-                             deltas.get("warm_hits"))):
+                             deltas.get("warm_hits")),
+                            ("cache.exec.hits",
+                             deltas.get("exec_hits")),
+                            ("cache.exec.misses",
+                             deltas.get("exec_misses"))):
             if value:
                 metrics.inc(name, value)
         frontier = deltas.get("frontier")

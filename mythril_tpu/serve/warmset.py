@@ -42,6 +42,16 @@ the engine runs, so the taint fixpoint — like the XLA compiles — is paid
 once per contract, not once per request. The store follows the same
 rules as the manifest: monotone union-merge on save, fsync-atomic
 writes, and tolerant loads that degrade to "rebuild the summary".
+
+Two more durable-warmth stores complete the picture (ISSUE 16): the
+**verdict sidecar** (``<manifest>.verdicts.json``) persists the
+canonical-CNF SAT/UNSAT verdict cache (smt/solver/dispatch.py) — loaded
+at worker spawn, union-merged at request end under a flock, bounded by
+``MYTHRIL_TPU_VERDICT_SIDECAR_MAX`` — and the **executable cache**
+(``parallel/exec_cache.py``, an ``exec_cache/`` directory beside the
+manifest) persists the compiled runners themselves, so
+:meth:`WarmSet.warmup` is deserialize-first and a respawned worker is
+ready in seconds with zero ``xla.bucket_compiles``.
 """
 
 from __future__ import annotations
@@ -59,6 +69,7 @@ log = logging.getLogger(__name__)
 
 MANIFEST_VERSION = 1
 SUMMARIES_VERSION = 1
+VERDICTS_VERSION = 1
 
 
 def default_manifest_path() -> str:
@@ -169,6 +180,102 @@ def save_summaries(path: str, summaries: Dict[str, dict]) -> int:
     return len(merged)
 
 
+def verdicts_path_for(manifest_path: str) -> str:
+    """The verdict sidecar sits beside the shape manifest:
+    ``warmset.json`` → ``warmset.verdicts.json``."""
+    base, _ = os.path.splitext(manifest_path)
+    return f"{base}.verdicts.json"
+
+
+def verdict_sidecar_enabled() -> bool:
+    """MYTHRIL_TPU_VERDICT_SIDECAR (default on)."""
+    return tpu_config.get_flag("MYTHRIL_TPU_VERDICT_SIDECAR")
+
+
+def _verdict_key(entry: list) -> str:
+    """Dedup key for one sidecar entry: the canonical CNF itself (the
+    verdict is a property of the clause set, so colliding entries are
+    interchangeable)."""
+    return json.dumps([entry[0], entry[1]])
+
+
+def load_verdicts(path: str) -> List[list]:
+    """Sidecar entries (JSON-shaped, see ``dispatch.export_verdicts``);
+    [] for missing, malformed, or unknown-version sidecars (logged,
+    never raised). Entries are shallow-checked here — deep validation
+    happens at ``dispatch.import_verdicts`` time."""
+    try:
+        with open(path, "r", encoding="utf-8") as handle:
+            doc = json.load(handle)
+    except FileNotFoundError:
+        return []
+    except (OSError, ValueError) as error:
+        log.warning("verdict sidecar %s unreadable (%s) — cold verdict "
+                    "cache", path, error)
+        return []
+    if not isinstance(doc, dict) or doc.get("version") != VERDICTS_VERSION:
+        log.warning("verdict sidecar %s has unsupported version %r — "
+                    "cold verdict cache", path,
+                    doc.get("version") if isinstance(doc, dict) else None)
+        return []
+    entries = []
+    for entry in doc.get("verdicts") or []:
+        if isinstance(entry, list) and len(entry) == 4:
+            entries.append(entry)
+        else:
+            log.warning("verdict sidecar %s: skipping malformed entry %r",
+                        path, entry)
+    return entries
+
+
+def save_verdicts(path: str, entries: List[list]) -> int:
+    """Union-merge `entries` into the sidecar at `path` and write it
+    fsync-atomically: what is on disk loads first, this process's
+    entries append (disk-order = age-order, so eviction under the
+    ``MYTHRIL_TPU_VERDICT_SIDECAR_MAX`` bound drops the OLDEST entries).
+    The load-merge-write runs under an exclusive flock on a ``.lock``
+    file beside the sidecar, so two workers flushing concurrently
+    serialize and neither's entries are lost (the lock guards the
+    read-modify-write; the fsync-atomic rename guards readers). Returns
+    the entry count written."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    lock_handle = None
+    try:
+        import fcntl
+
+        lock_handle = open(f"{path}.lock", "w", encoding="utf-8")
+        fcntl.flock(lock_handle, fcntl.LOCK_EX)
+    except (ImportError, OSError):
+        lock_handle = None  # non-POSIX: rename atomicity still holds
+    try:
+        merged: Dict[str, list] = {}
+        for entry in load_verdicts(path):
+            merged[_verdict_key(entry)] = entry
+        fresh = 0
+        for entry in entries:
+            key = _verdict_key(entry)
+            if key not in merged:
+                fresh += 1
+            merged[key] = entry
+        if fresh:
+            metrics.inc("cache.verdict.merged", fresh)
+        ordered = list(merged.values())
+        bound = max(1,
+                    tpu_config.get_int("MYTHRIL_TPU_VERDICT_SIDECAR_MAX"))
+        if len(ordered) > bound:
+            metrics.inc("cache.verdict.evicted", len(ordered) - bound)
+            ordered = ordered[len(ordered) - bound:]
+        payload = {"version": VERDICTS_VERSION, "verdicts": ordered}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as handle:
+            json.dump(payload, handle, indent=1)
+        fsync_replace(tmp, path)
+        return len(ordered)
+    finally:
+        if lock_handle is not None:
+            lock_handle.close()
+
+
 class WarmSet:
     """The daemon's view of the warm buckets: load → warm → record.
 
@@ -179,6 +286,8 @@ class WarmSet:
         self.path = path
         self.warmed: List[Tuple] = []
         self.failed: List[Tuple] = []
+        #: verdict-cache entries loaded from the sidecar at warmup
+        self.loaded_verdicts = 0
         # taint summaries recorded this process, pending persistence
         self._pending_summaries: Dict[str, dict] = {}
         # lazy-loaded view of the on-disk store (None = not loaded yet)
@@ -186,6 +295,9 @@ class WarmSet:
 
     def _summaries_path(self) -> Optional[str]:
         return summaries_path_for(self.path) if self.path else None
+
+    def _verdicts_path(self) -> Optional[str]:
+        return verdicts_path_for(self.path) if self.path else None
 
     def summary_for(self, code_hash: str) -> Optional[dict]:
         """The persisted ContractSummary JSON for a bytecode hash, if any
@@ -221,14 +333,47 @@ class WarmSet:
                         metrics.inc("serve.warmed_buckets")
                     else:
                         self.failed.append(shape)
-            span.set(warmed=len(self.warmed), failed=len(self.failed))
+            self.loaded_verdicts = self._load_verdicts()
+            span.set(warmed=len(self.warmed), failed=len(self.failed),
+                     exec_hits=int(metrics.value("cache.exec.hits")),
+                     exec_misses=int(metrics.value("cache.exec.misses")),
+                     verdicts_loaded=self.loaded_verdicts)
         if self.failed:
             log.warning("warmup skipped %d un-warmable manifest shapes "
                         "(different mesh or malformed): %s",
                         len(self.failed), self.failed[:4])
-        log.info("warmup pre-compiled %d clause-shape buckets",
-                 len(self.warmed))
+        log.info("warmup pre-compiled %d clause-shape buckets "
+                 "(%d from the executable cache), loaded %d verdicts",
+                 len(self.warmed), int(metrics.value("cache.exec.hits")),
+                 self.loaded_verdicts)
         return len(self.warmed)
+
+    def _load_verdicts(self) -> int:
+        """Seed the dispatch verdict cache from the persisted sidecar
+        (worker spawn / daemon warmup). Best-effort: an unreadable or
+        stale sidecar is a cold cache, never a failed startup."""
+        path = self._verdicts_path()
+        if not path or not verdict_sidecar_enabled():
+            return 0
+        from ..smt.solver import dispatch
+
+        return dispatch.import_verdicts(load_verdicts(path))
+
+    def _flush_verdicts(self) -> None:
+        """Union-merge this process's verdict cache into the sidecar."""
+        path = self._verdicts_path()
+        if not path or not verdict_sidecar_enabled():
+            return
+        from ..smt.solver import dispatch
+
+        entries = dispatch.export_verdicts()
+        if not entries:
+            return
+        try:
+            save_verdicts(path, entries)
+        except OSError as error:
+            log.warning("could not persist verdict sidecar %s: %s",
+                        path, error)
 
     def record_observed(self) -> int:
         """Persist every shape this process has compiled so far (warmup
@@ -238,6 +383,7 @@ class WarmSet:
         if not self.path:
             return 0
         self._flush_summaries()
+        self._flush_verdicts()
         from ..parallel import jax_solver
 
         observed = jax_solver.observed_shape_keys()
@@ -279,4 +425,9 @@ class WarmSet:
             "observed_buckets": len(jax_solver.observed_shape_keys()),
             "taint_summaries": len(set(self._stored_summaries)
                                    | set(self._pending_summaries)),
+            "exec_cache": {
+                "hits": int(metrics.value("cache.exec.hits")),
+                "misses": int(metrics.value("cache.exec.misses")),
+            },
+            "verdicts_loaded": self.loaded_verdicts,
         }

@@ -5,7 +5,10 @@
 over JSON-lines jobs on stdin — one ``analyze`` (or one fleet
 micro-batch) per job — writing JSON-lines events back on stdout:
 
-* ``{"event": "ready", "pid": ..., "warmed": N}`` — once, after warmup;
+* ``{"event": "ready", "pid": ..., "warmed": N, "exec_hits": ...,
+  "exec_misses": ..., "verdicts_loaded": ...}`` — once, after the
+  deserialize-first pre-warm (the supervisor folds the durable-warmth
+  counters into the daemon's ``cache.exec.*`` / ``cache.verdict.*``);
 * ``{"event": "heartbeat", "job_id": ...}`` — from a daemon thread
   while a job is running, so the supervisor can tell "slow" from
   "wedged" (a silent worker past the heartbeat timeout is killed and
@@ -133,6 +136,8 @@ def _run_analyze(service, job: dict) -> dict:
         params = _ladder_params(params)
     cold_before = metrics.value("xla.bucket_compiles")
     warm_before = metrics.value("xla.bucket_reuses")
+    exec_hits_before = metrics.value("cache.exec.hits")
+    exec_misses_before = metrics.value("cache.exec.misses")
     frontier_before = _frontier_counters()
     payload = service._run_analysis_local(
         params, checkpoint_path=job.get("checkpoint"),
@@ -140,6 +145,9 @@ def _run_analyze(service, job: dict) -> dict:
     payload["serve_metrics"] = {
         "cold_buckets": metrics.value("xla.bucket_compiles") - cold_before,
         "warm_hits": metrics.value("xla.bucket_reuses") - warm_before,
+        "exec_hits": metrics.value("cache.exec.hits") - exec_hits_before,
+        "exec_misses":
+            metrics.value("cache.exec.misses") - exec_misses_before,
         "frontier": {name: value - frontier_before[name]
                      for name, value in _frontier_counters().items()},
     }
@@ -218,8 +226,17 @@ def main(argv=None) -> int:
     warmed = 0
     if not args.no_warmup:
         warmed = service.warmset.warmup()
-    writer.send(event="ready", pid=os.getpid(), warmed=warmed)
-    log.info("worker ready (warmed %d buckets)", warmed)
+    # deserialize-first pre-warm accounting rides the ready event: the
+    # supervisor folds these into the daemon's cache.exec.* / verdict
+    # counters, so /healthz shows pool-wide durable-warmth coverage
+    writer.send(event="ready", pid=os.getpid(), warmed=warmed,
+                exec_hits=int(metrics.value("cache.exec.hits")),
+                exec_misses=int(metrics.value("cache.exec.misses")),
+                verdicts_loaded=service.warmset.loaded_verdicts)
+    log.info("worker ready (warmed %d buckets, %d from the executable "
+             "cache, %d verdicts loaded)", warmed,
+             int(metrics.value("cache.exec.hits")),
+             service.warmset.loaded_verdicts)
 
     beat_s = max(args.heartbeat_ms, 200) / 4000.0
     for line in sys.stdin:

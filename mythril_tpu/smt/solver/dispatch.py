@@ -440,3 +440,64 @@ def cached_verdicts() -> int:
 
 def reset(keep_verdicts: bool = False) -> None:
     _QUEUE.reset(keep_verdicts=keep_verdicts)
+
+
+# -- verdict-cache persistence (serve/warmset.py verdict sidecar) --------------------
+
+def export_verdicts() -> List[list]:
+    """The verdict cache as JSON-shaped entries, oldest first:
+    ``[n_vars, [[lit, ...], ...], status, model-or-null]`` per entry.
+    Only SAT/UNSAT ever enter the cache, so every exported entry is a
+    real decision the next process can trust."""
+    entries = []
+    for (n_vars, clauses), (status, model) in _QUEUE.cache.items():
+        entries.append([n_vars, [list(lits) for lits in clauses], status,
+                        list(model) if model is not None else None])
+    return entries
+
+
+def _valid_entry(entry) -> Optional[Tuple[CanonicalKey, int,
+                                          Optional[Tuple[bool, ...]]]]:
+    """Shape-check one sidecar entry; None for anything malformed — a
+    corrupt sidecar must degrade to a cold cache, never a crash."""
+    try:
+        n_vars, clauses, status, model = entry
+        if not isinstance(n_vars, int) or isinstance(n_vars, bool) \
+                or n_vars < 0:
+            return None
+        if status not in (sat.SAT, sat.UNSAT):
+            return None
+        key_clauses = []
+        for lits in clauses:
+            if not all(isinstance(lit, int) and not isinstance(lit, bool)
+                       for lit in lits):
+                return None
+            key_clauses.append(tuple(lits))
+        if model is not None:
+            if not all(isinstance(bit, bool) for bit in model):
+                return None
+            model = tuple(model)
+        return (n_vars, tuple(key_clauses)), status, model
+    except (TypeError, ValueError):
+        return None
+
+
+def import_verdicts(entries: List[list]) -> int:
+    """Load persisted sidecar entries into the verdict cache (counted in
+    ``cache.verdict.loaded``). In-memory verdicts win ties — they are at
+    least as fresh — and malformed entries are skipped silently. Returns
+    the count actually inserted."""
+    loaded = 0
+    for entry in entries:
+        parsed = _valid_entry(entry)
+        if parsed is None:
+            continue
+        key, status, model = parsed
+        if key in _QUEUE.cache:
+            continue
+        _QUEUE._cache_put(key, status,
+                          list(model) if model is not None else None)
+        loaded += 1
+    if loaded:
+        metrics.inc("cache.verdict.loaded", loaded)
+    return loaded

@@ -165,6 +165,21 @@ _KNOBS: List[Knob] = [
          "Worker deaths attributed to one bytecode hash before the "
          "contract lands in the poison-quarantine sidecar and further "
          "requests for it are refused with a `quarantined` error."),
+    # -- durable warmth (parallel/exec_cache.py, serve/warmset.py) ----------------
+    Knob("MYTHRIL_TPU_EXEC_CACHE", "flag", True,
+         "Persistent executable cache: serialize compiled solver runners "
+         "(JAX AOT) beside the warmset manifest so worker respawn "
+         "deserializes instead of recompiling; 0 disables for A/B."),
+    Knob("MYTHRIL_TPU_EXEC_CACHE_DIR", "str", None,
+         "Directory for serialized solver executables (dynamic default: "
+         "an `exec_cache/` directory beside the warmset manifest)."),
+    Knob("MYTHRIL_TPU_VERDICT_SIDECAR", "flag", True,
+         "Persist the canonical-CNF SAT/UNSAT verdict cache to a "
+         "union-merge sidecar beside the warmset manifest, loaded at "
+         "worker spawn and merged at request end; 0 disables."),
+    Knob("MYTHRIL_TPU_VERDICT_SIDECAR_MAX", "int", 65536,
+         "Max entries kept in the persisted verdict sidecar; beyond it "
+         "the oldest entries are evicted at save time."),
     # -- observability (mythril_tpu/observe/) -------------------------------------
     Knob("MYTHRIL_TPU_TRACE", "str", None,
          "Write a Chrome/Perfetto trace_event JSON to this path; setting "

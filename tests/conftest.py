@@ -6,6 +6,13 @@ very top so any transitive jax import sees them.
 """
 
 import os
+import tempfile
+
+# Hermetic executable cache: tests must neither read warm entries from a
+# developer's ~/.mythril_tpu/exec_cache (a deserialize hit would skew
+# compile-count assertions) nor pollute it with test-shaped runners.
+os.environ.setdefault("MYTHRIL_TPU_EXEC_CACHE_DIR",
+                      tempfile.mkdtemp(prefix="mythril_exec_cache_test_"))
 
 # Force CPU with 8 virtual devices even when the shell environment selects a
 # TPU platform (JAX_PLATFORMS=axon): CI correctness tests must not contend for

@@ -314,8 +314,13 @@ def test_second_request_hits_warm_buckets(monkeypatch):
     second = service.handle(protocol.parse_request(
         '{"op": "analyze", "id": "c2", "code": "60"}'))
     assert first["ok"] and second["ok"]
-    assert first["warm"] == {"cold_buckets": 1, "warm_hits": 0}
-    assert second["warm"] == {"cold_buckets": 0, "warm_hits": 1}
+    # exec cache: the fake runner's bucket misses the (empty) persistent
+    # store on first touch; the second request reuses in-process warmth
+    # and never consults it
+    assert first["warm"] == {"cold_buckets": 1, "warm_hits": 0,
+                             "exec_hits": 0, "exec_misses": 1}
+    assert second["warm"] == {"cold_buckets": 0, "warm_hits": 1,
+                              "exec_hits": 0, "exec_misses": 0}
     assert metrics.value("serve.requests") == 2
     hist = metrics.histogram("serve.request_ms")
     assert hist is not None and hist.count == 2

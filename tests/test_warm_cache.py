@@ -1,0 +1,252 @@
+"""Durable-warmth tests: the persistent executable cache
+(parallel/exec_cache.py) and the verdict sidecar (serve/warmset.py +
+smt/solver/dispatch.py export/import).
+
+One test pays a real (small) XLA compile to prove the serialize →
+deserialize → run roundtrip; everything else is file-level and fast.
+The cross-process acceptance check lives in tools/warm_smoke.py."""
+
+import json
+import os
+import pickle
+import threading
+
+import pytest
+
+from mythril_tpu.observe import metrics
+from mythril_tpu.parallel import exec_cache, jax_solver
+from mythril_tpu.serve import warmset
+from mythril_tpu.smt.solver import dispatch, sat
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch, tmp_path):
+    metrics.reset()
+    dispatch.reset()
+    monkeypatch.setenv("MYTHRIL_TPU_EXEC_CACHE_DIR",
+                       str(tmp_path / "exec_cache"))
+    monkeypatch.setattr(jax_solver, "_SHAPES_RUN", set())
+    monkeypatch.setattr(jax_solver, "_AOT_EXECUTABLES", {})
+    yield
+    metrics.reset()
+    dispatch.reset()
+
+
+#: tiny single-device bucket — compiles in ~1 s on the CPU backend
+SMALL_KEY = ("single", 1, 8, 0, 1, 1024, 2)
+
+
+# -- executable cache ----------------------------------------------------------------
+
+
+def test_exec_cache_real_compile_persists_entry():
+    """Cold warm_shape_key AOT-compiles the runner and persists a
+    keyed entry beside the manifest. (The deserialize side of the real
+    roundtrip is cross-process by design — a fresh interpreter, as in
+    production worker respawn — and is gated end to end by
+    tools/warm_smoke.py; re-loading in THIS process, alongside every
+    other test's compiled programs, trips XLA symbol-table collisions
+    that a real respawn can never see.)"""
+    assert jax_solver.warm_shape_key(SMALL_KEY)
+    assert metrics.value("xla.bucket_compiles") == 1
+    path = exec_cache.entry_path(SMALL_KEY)
+    assert os.path.exists(path)
+    with open(path, "rb") as handle:
+        doc = pickle.loads(handle.read())
+    assert doc["key"] == exec_cache.entry_key(SMALL_KEY)
+    assert doc["payload"]  # non-empty serialized executable
+
+
+def test_exec_cache_roundtrip_warm_respawn(monkeypatch):
+    """Store → load roundtrip through the full keying/metrics path,
+    with the jax serializer faked so the 'respawn' is deterministic
+    in-process (the real-XLA roundtrip is tools/warm_smoke.py's)."""
+    from jax.experimental import serialize_executable
+
+    sentinel = object()
+    monkeypatch.setattr(serialize_executable, "serialize",
+                        lambda compiled: (b"payload", "in", "out"))
+    monkeypatch.setattr(
+        serialize_executable, "deserialize_and_load",
+        lambda payload, in_tree, out_tree: sentinel
+        if (payload, in_tree, out_tree) == (b"payload", "in", "out")
+        else None)
+    assert exec_cache.store(SMALL_KEY, object())
+    assert exec_cache.load(SMALL_KEY) is sentinel
+    assert metrics.value("cache.exec.hits") == 1
+    assert metrics.value("cache.exec.misses") == 0
+
+
+def test_exec_cache_schema_bump_invalidates(monkeypatch):
+    """Bumping SCHEMA_VERSION orphans every persisted entry cleanly:
+    the old file is simply never found (new key → new path) and the
+    caller falls back to compile."""
+    path = exec_cache.entry_path(SMALL_KEY)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as handle:
+        handle.write(pickle.dumps({"key": exec_cache.entry_key(SMALL_KEY),
+                                   "payload": b"", "in_tree": None,
+                                   "out_tree": None}))
+    monkeypatch.setattr(exec_cache, "SCHEMA_VERSION",
+                        exec_cache.SCHEMA_VERSION + 1)
+    monkeypatch.setattr(exec_cache, "_FINGERPRINT", None)
+    assert exec_cache.entry_path(SMALL_KEY) != path
+    assert exec_cache.load(SMALL_KEY) is None
+    assert metrics.value("cache.exec.misses") == 1
+    assert metrics.value("cache.exec.hits") == 0
+
+
+@pytest.mark.parametrize("blob", [
+    b"",                                   # truncated to nothing
+    b"not a pickle at all",                # garbage bytes
+    pickle.dumps(["wrong", "shape"]),      # valid pickle, wrong doc
+    pickle.dumps({"key": "stale-key", "payload": b"", "in_tree": None,
+                  "out_tree": None}),      # hash collision / stale key
+])
+def test_exec_cache_corrupt_entry_falls_back(blob):
+    path = exec_cache.entry_path(SMALL_KEY)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as handle:
+        handle.write(blob)
+    assert exec_cache.load(SMALL_KEY) is None  # never raises
+    assert metrics.value("cache.exec.misses") == 1
+
+
+def test_exec_cache_skips_sharded_and_malformed_keys():
+    assert not exec_cache.cacheable(("single", 8, 256, 5, 1, 1024, 32))
+    assert not exec_cache.cacheable(("bogus",))
+    assert not exec_cache.cacheable("not-a-tuple")
+    assert exec_cache.cacheable(("single", 1, 256, 5, 1, 1024, 32))
+    assert exec_cache.cacheable(("batch", 256, 5, 1, 1024, 4, 32))
+    # uncacheable keys are not even counted as misses (nothing to miss)
+    assert exec_cache.load(("single", 8, 256, 5, 1, 1024, 32)) is None
+    assert metrics.value("cache.exec.misses") == 0
+
+
+def test_exec_cache_disabled_by_knob(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_EXEC_CACHE", "0")
+    assert not exec_cache.enabled()
+    assert exec_cache.load(SMALL_KEY) is None
+    assert exec_cache.store(SMALL_KEY, object()) is False
+    assert metrics.value("cache.exec.misses") == 0
+
+
+# -- verdict sidecar -----------------------------------------------------------------
+
+
+def _entry(n_vars, clauses, status, model=None):
+    return [n_vars, clauses, status, model]
+
+
+def test_verdict_sidecar_roundtrip(tmp_path):
+    path = str(tmp_path / "warmset.verdicts.json")
+    entries = [_entry(2, [[1, 2], [-1]], sat.SAT, [False, True]),
+               _entry(1, [[1], [-1]], sat.UNSAT)]
+    assert warmset.save_verdicts(path, entries) == 2
+    assert warmset.load_verdicts(path) == entries
+    assert metrics.value("cache.verdict.merged") == 2
+
+
+def test_verdict_sidecar_tolerates_garbage(tmp_path):
+    path = tmp_path / "warmset.verdicts.json"
+    path.write_text("{ not json")
+    assert warmset.load_verdicts(str(path)) == []
+    path.write_text(json.dumps({"version": 999, "verdicts": []}))
+    assert warmset.load_verdicts(str(path)) == []
+    path.write_text(json.dumps(
+        {"version": warmset.VERDICTS_VERSION,
+         "verdicts": [["malformed"], _entry(1, [[1]], sat.SAT, [True])]}))
+    assert warmset.load_verdicts(str(path)) == \
+        [_entry(1, [[1]], sat.SAT, [True])]
+
+
+def test_verdict_sidecar_concurrent_merge_loses_nothing(tmp_path):
+    """Two 'workers' flushing disjoint verdict sets concurrently: the
+    flock around the read-modify-write means the union survives."""
+    path = str(tmp_path / "warmset.verdicts.json")
+    batches = [[_entry(worker * 100 + i, [[1]], sat.SAT, [True])
+                for i in range(20)] for worker in range(2)]
+    threads = [threading.Thread(target=warmset.save_verdicts,
+                                args=(path, batch)) for batch in batches]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    keys = {warmset._verdict_key(e) for e in warmset.load_verdicts(path)}
+    expected = {warmset._verdict_key(e) for batch in batches
+                for e in batch}
+    assert keys == expected
+
+
+def test_verdict_sidecar_eviction_respects_bound(tmp_path, monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_VERDICT_SIDECAR_MAX", "3")
+    path = str(tmp_path / "warmset.verdicts.json")
+    entries = [_entry(i, [[1]], sat.SAT, [True]) for i in range(5)]
+    assert warmset.save_verdicts(path, entries) == 3
+    kept = warmset.load_verdicts(path)
+    assert [e[0] for e in kept] == [2, 3, 4]  # oldest evicted first
+    assert metrics.value("cache.verdict.evicted") == 2
+    # a later merge keeps honoring the bound
+    assert warmset.save_verdicts(
+        path, [_entry(9, [[1]], sat.SAT, [True])]) == 3
+    assert [e[0] for e in warmset.load_verdicts(path)] == [3, 4, 9]
+
+
+# -- dispatch export/import ----------------------------------------------------------
+
+
+def test_dispatch_verdict_export_import_roundtrip():
+    dispatch._QUEUE._cache_put((2, ((1, 2), (-1,))), sat.SAT,
+                               [False, True])
+    dispatch._QUEUE._cache_put((1, ((1,), (-1,))), sat.UNSAT, None)
+    exported = dispatch.export_verdicts()
+    assert exported == [[2, [[1, 2], [-1]], sat.SAT, [False, True]],
+                        [1, [[1], [-1]], sat.UNSAT, None]]
+    dispatch.reset()  # cold process
+    assert dispatch.import_verdicts(exported) == 2
+    assert metrics.value("cache.verdict.loaded") == 2
+    assert dispatch._QUEUE._cache_get((2, ((1, 2), (-1,)))) == \
+        (sat.SAT, (False, True))
+
+
+def test_dispatch_import_rejects_malformed_and_keeps_memory():
+    dispatch._QUEUE._cache_put((1, ((1,),)), sat.SAT, [True])
+    bad = [
+        ["one", [[1]], sat.SAT, None],          # n_vars not an int
+        [True, [[1]], sat.SAT, None],           # bool masquerading as int
+        [1, [[1]], sat.UNKNOWN, None],          # UNKNOWN is not a verdict
+        [1, [[1, "x"]], sat.SAT, None],         # literal not an int
+        [1, [[1]], sat.SAT, [1, 0]],            # model bits not bools
+        [1, [[1]]],                             # wrong arity
+        "not even a list",
+    ]
+    # the in-memory SAT for key (1, ((1,),)) must win over this UNSAT
+    stale = [1, [[1]], sat.UNSAT, None]
+    assert dispatch.import_verdicts(bad + [stale]) == 0
+    assert dispatch._QUEUE._cache_get((1, ((1,),))) == (sat.SAT, (True,))
+    assert metrics.value("cache.verdict.loaded") == 0
+
+
+def test_warmset_warmup_seeds_verdict_cache(tmp_path):
+    """WarmSet.warmup() with an empty shape manifest still imports the
+    verdict sidecar — a respawned worker answers repeat CNFs from
+    cache before its first device launch."""
+    manifest = str(tmp_path / "warmset.json")
+    warmset.save_verdicts(warmset.verdicts_path_for(manifest),
+                          [_entry(1, [[1]], sat.SAT, [True])])
+    ws = warmset.WarmSet(manifest)
+    assert ws.warmup() == 0  # no shapes to warm
+    assert ws.loaded_verdicts == 1
+    assert dispatch._QUEUE._cache_get((1, ((1,),))) == (sat.SAT, (True,))
+    assert ws.status()["verdicts_loaded"] == 1
+
+
+def test_warmset_verdict_sidecar_disabled_by_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_VERDICT_SIDECAR", "0")
+    manifest = str(tmp_path / "warmset.json")
+    warmset.save_verdicts(warmset.verdicts_path_for(manifest),
+                          [_entry(1, [[1]], sat.SAT, [True])])
+    ws = warmset.WarmSet(manifest)
+    assert ws.warmup() == 0
+    assert ws.loaded_verdicts == 0
+    assert dispatch._QUEUE._cache_get((1, ((1,),))) is None

@@ -115,6 +115,13 @@ def extract_points(round_label: str, run: dict) -> List[Point]:
                 key = (series, parsed.get("backend"), fleet.get("contracts"))
                 points.append(Point(series, key, round_label,
                                     field_value, "x"))
+    warm = parsed.get("warm_start")
+    if isinstance(warm, dict):
+        speedup = _num(warm.get("spawn_speedup"))
+        if speedup is not None:
+            series = "warm_start.spawn_speedup"
+            key = (series, parsed.get("backend"))
+            points.append(Point(series, key, round_label, speedup, "x"))
     corpus = parsed.get("corpus")
     if isinstance(corpus, dict):
         for engine in sorted(corpus):

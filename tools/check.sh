@@ -58,6 +58,11 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python -m tools.chaos_smoke || exit $?
 
 echo
+echo "== warm smoke (cold compile+persist -> fresh process respawns warm) =="
+timeout -k 10 400 env JAX_PLATFORMS=cpu \
+    python -m tools.warm_smoke || exit $?
+
+echo
 echo "== tier-1 (pytest, not slow, 870s budget) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

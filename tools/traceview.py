@@ -263,6 +263,12 @@ def _serve_section(spans: List[dict],
         if args.get("failed"):
             line += f", {args['failed']} unwarmable"
         lines.append(line)
+        if "exec_hits" in args or "verdicts_loaded" in args:
+            lines.append(
+                f"    durable warmth: exec cache "
+                f"{args.get('exec_hits', 0)} hit(s) / "
+                f"{args.get('exec_misses', 0)} miss(es), "
+                f"{args.get('verdicts_loaded', 0)} verdict(s) loaded")
     if not warmups:
         lines.append("  (no warmup span — daemon started with warmup off)")
     if requests:
@@ -286,7 +292,9 @@ def _serve_section(spans: List[dict],
             f"  request {args.get('request_id', '?')}: {_fmt_us(dur)}  "
             f"cold_buckets={args.get('cold_buckets', '?')} "
             f"warm_hits={args.get('warm_hits', '?')} "
-            f"issues={args.get('issues', '?')}"
+            + (f"exec_hits={args['exec_hits']} " if "exec_hits" in args
+               else "")
+            + f"issues={args.get('issues', '?')}"
             + (f" cid={cid}" if cid else ""))
         inner = [
             s for s in spans

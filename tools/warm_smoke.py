@@ -1,0 +1,221 @@
+"""Durable-warmth smoke for the pre-merge gate (tools/check.sh).
+
+Two-process cold→warm replay against a private warmset directory
+(CPU-only, tiny CNF corpus, so it stays cheap):
+
+1. **cold** (child 1): pushes a small CNF corpus through the batched
+   device dispatch — every shape bucket pays its ``xla.bucket_compiles``
+   compile, and ``parallel/exec_cache.py`` persists each compiled
+   runner beside the manifest — then ``WarmSet.record_observed()``
+   writes the shape manifest and the verdict sidecar.
+2. **warm** (child 2, a fresh interpreter): ``WarmSet.warmup()`` must
+   be deserialize-only — **zero** ``xla.bucket_compiles``, executable
+   cache hits > 0, verdicts loaded > 0, and respawn-to-ready under the
+   2 s acceptance bound — and a replay of the same corpus must answer
+   from the imported verdict cache (``dispatch.cache_hits`` > 0) with
+   the compile counter still at zero.
+
+The two children share only the on-disk stores (warmset manifest,
+``exec_cache/`` payloads, verdict sidecar, persistent XLA cache), so a
+pass proves a respawned worker really is a cache read, not a recompile.
+
+Prints ``WARM_SMOKE=ok`` on success; any failure exits non-zero with a
+diagnostic. The caller bounds the wall clock (check.sh wraps this in
+`timeout`)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: Acceptance bound: a warm respawn must reach ready inside this wall.
+WARM_READY_BUDGET_S = 2.0
+
+#: Tiny deterministic corpus — small enough that the cold compile stays
+#: in CI budget, varied enough to exercise SAT and UNSAT verdicts.
+_CORPUS = [
+    # SAT: (x1 | x2) & (!x1 | x2) & (x1 | !x2)  -> x1=x2=True
+    ([[1, 2], [-1, 2], [1, -2]], 2),
+    # UNSAT: x1 & !x1
+    ([[1], [-1]], 1),
+    # SAT: 3 vars, mixed widths
+    ([[1, 2, 3], [-1, -2], [2, 3]], 3),
+]
+
+
+def _solve_corpus() -> list:
+    """Run the corpus through the batched device dispatch; returns the
+    verdict list (dispatch caches every SAT/UNSAT on the way)."""
+    from mythril_tpu.smt.solver import dispatch
+
+    futures = [dispatch.submit(clauses, n_vars, max_conflicts=4096)
+               for clauses, n_vars in _CORPUS]
+    dispatch.flush()
+    return [future.result()[0] for future in futures]
+
+
+def _run_cold(manifest: str) -> int:
+    from mythril_tpu.observe import metrics
+    from mythril_tpu.serve.warmset import WarmSet
+    from mythril_tpu.smt.solver import dispatch, sat
+
+    verdicts = _solve_corpus()
+    decided = [v for v in verdicts if v in (sat.SAT, sat.UNSAT)]
+    if not decided:
+        print(f"cold: no decided verdicts (got {verdicts}) — nothing to "
+              "persist", file=sys.stderr)
+        return 1
+    if not dispatch.export_verdicts():
+        print("cold: verdict cache is empty after decided solves",
+              file=sys.stderr)
+        return 1
+    compiles = int(metrics.value("xla.bucket_compiles"))
+    if compiles < 1:
+        print("cold: expected at least one bucket compile, saw "
+              f"{compiles}", file=sys.stderr)
+        return 1
+    WarmSet(manifest).record_observed()
+    cache_dir = os.environ["MYTHRIL_TPU_EXEC_CACHE_DIR"]
+    stored = [f for f in os.listdir(cache_dir) if f.endswith(".jexec")] \
+        if os.path.isdir(cache_dir) else []
+    if not stored:
+        print(f"cold: no serialized executables in {cache_dir}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps({"compiles": compiles, "stored": len(stored),
+                      "verdicts": len(dispatch.export_verdicts())}))
+    return 0
+
+
+def _run_warm(manifest: str) -> int:
+    from mythril_tpu.observe import metrics
+    from mythril_tpu.serve.warmset import WarmSet
+    from mythril_tpu.smt.solver import dispatch, sat
+
+    warmset = WarmSet(manifest)
+    started = time.perf_counter()
+    warmed = warmset.warmup()
+    ready_wall = time.perf_counter() - started
+
+    problems = []
+    compiles = int(metrics.value("xla.bucket_compiles"))
+    exec_hits = int(metrics.value("cache.exec.hits"))
+    if warmed < 1:
+        problems.append(f"warmed {warmed} buckets, expected >= 1")
+    if compiles != 0:
+        problems.append(f"warm respawn paid {compiles} bucket compile(s), "
+                        "expected 0 (deserialize-only)")
+    if exec_hits < 1:
+        problems.append(f"executable cache hits {exec_hits}, expected >= 1")
+    if warmset.loaded_verdicts < 1:
+        problems.append(f"loaded {warmset.loaded_verdicts} verdicts, "
+                        "expected >= 1")
+    if ready_wall >= WARM_READY_BUDGET_S:
+        problems.append(f"respawn-to-ready took {ready_wall:.2f}s, budget "
+                        f"{WARM_READY_BUDGET_S:.1f}s")
+
+    # replay: every corpus verdict must come from the imported cache,
+    # and the replay itself must not trigger a compile
+    verdicts = _solve_corpus()
+    decided = [v for v in verdicts if v in (sat.SAT, sat.UNSAT)]
+    verdict_hits = int(metrics.value("dispatch.cache_hits"))
+    if len(decided) != len(_CORPUS):
+        problems.append(f"replay decided {len(decided)}/{len(_CORPUS)} "
+                        "corpus queries")
+    if verdict_hits < 1:
+        problems.append(f"replay verdict-cache hits {verdict_hits}, "
+                        "expected >= 1")
+    replay_compiles = int(metrics.value("xla.bucket_compiles"))
+    if replay_compiles != 0:
+        problems.append(f"replay paid {replay_compiles} bucket compile(s), "
+                        "expected 0")
+
+    for problem in problems:
+        print(f"warm: {problem}", file=sys.stderr)
+    if problems:
+        return 1
+    print(json.dumps({"ready_s": round(ready_wall, 3), "warmed": warmed,
+                      "exec_hits": exec_hits,
+                      "verdicts_loaded": warmset.loaded_verdicts,
+                      "verdict_hits": verdict_hits}))
+    return 0
+
+
+def _run_ready(manifest: str) -> int:
+    """Neutral spawn-to-ready timing (no asserts): bench.py's
+    ``warm_start`` phase runs this twice — once against an empty
+    executable cache (cold respawn) and once against the seeded one —
+    and reports the ratio as the spawn speedup."""
+    from mythril_tpu.observe import metrics
+    from mythril_tpu.serve.warmset import WarmSet
+
+    warmset = WarmSet(manifest)
+    started = time.perf_counter()
+    warmed = warmset.warmup()
+    print(json.dumps({
+        "ready_s": round(time.perf_counter() - started, 3),
+        "warmed": warmed,
+        "compiles": int(metrics.value("xla.bucket_compiles")),
+        "exec_hits": int(metrics.value("cache.exec.hits")),
+        "verdicts_loaded": warmset.loaded_verdicts}))
+    return 0
+
+
+def _child(phase: str, workdir: str) -> subprocess.CompletedProcess:
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        MYTHRIL_TPU_SERVE_MANIFEST=os.path.join(workdir, "warmset.json"),
+        MYTHRIL_TPU_EXEC_CACHE_DIR=os.path.join(workdir, "exec_cache"),
+        MYTHRIL_TPU_JAX_CACHE=os.path.join(workdir, "xla_cache"))
+    return subprocess.run(
+        [sys.executable, "-m", "tools.warm_smoke", "--phase", phase,
+         "--manifest", env["MYTHRIL_TPU_SERVE_MANIFEST"]],
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=300)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.warm_smoke",
+        description="two-process durable-warmth gate (cold compile+persist, "
+                    "then a fresh process must respawn warm)")
+    parser.add_argument("--phase", choices=("cold", "warm", "ready"),
+                        default=None,
+                        help="internal: run one child phase in-process")
+    parser.add_argument("--manifest", default=None)
+    args = parser.parse_args(argv)
+
+    if args.phase == "cold":
+        return _run_cold(args.manifest)
+    if args.phase == "warm":
+        return _run_warm(args.manifest)
+    if args.phase == "ready":
+        return _run_ready(args.manifest)
+
+    workdir = tempfile.mkdtemp(prefix="warm_smoke_")
+    for phase in ("cold", "warm"):
+        started = time.perf_counter()
+        result = _child(phase, workdir)
+        wall = time.perf_counter() - started
+        if result.returncode != 0:
+            sys.stderr.write(result.stdout)
+            sys.stderr.write(result.stderr)
+            print(f"WARM_SMOKE={phase} phase failed "
+                  f"(rc={result.returncode})", file=sys.stderr)
+            return 1
+        print(f"{phase}: {result.stdout.strip().splitlines()[-1]} "
+              f"({wall:.1f}s)")
+    print("WARM_SMOKE=ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
