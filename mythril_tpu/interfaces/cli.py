@@ -296,6 +296,23 @@ def _add_serve_args(parser: argparse.ArgumentParser) -> None:
                              "request is retried once, repeat-offender "
                              "contracts are quarantined (same as "
                              "MYTHRIL_TPU_SERVE_WORKERS=N; 0 disables)")
+    daemon.add_argument("--workers-min", type=int, default=None,
+                        metavar="N",
+                        help="autoscale floor for the worker pool (same "
+                             "as MYTHRIL_TPU_SERVE_WORKERS_MIN; 0 uses "
+                             "the --workers size)")
+    daemon.add_argument("--workers-max", type=int, default=None,
+                        metavar="N",
+                        help="autoscale ceiling for the worker pool: the "
+                             "supervisor grows the pool on sustained "
+                             "backlog and shrinks it on sustained idle "
+                             "(same as MYTHRIL_TPU_SERVE_WORKERS_MAX; "
+                             "0, the default, keeps the pool fixed)")
+    daemon.add_argument("--queue-max", type=int, default=None, metavar="N",
+                        help="bounded admission-queue capacity; past it "
+                             "the lowest-priority oldest waiter is shed "
+                             "with a typed `overloaded` error (same as "
+                             "MYTHRIL_TPU_SERVE_QUEUE_MAX)")
     daemon.add_argument("--inject-fault", default=None, metavar="SPEC",
                         help="deterministic fault injection for the worker "
                              "pool, e.g. worker_segv:2 (kill the worker on "
@@ -305,9 +322,20 @@ def _add_serve_args(parser: argparse.ArgumentParser) -> None:
 
 
 def _cmd_serve(cli_args) -> int:
+    from ..serve.daemon import install_sigterm_drain
     from ..serve.service import AnalysisService
     from ..serve.warmset import default_manifest_path
 
+    # flags are sugar over the knobs the admission queue and autoscaler
+    # read at construction time
+    for flag, knob in ((cli_args.workers_min,
+                        "MYTHRIL_TPU_SERVE_WORKERS_MIN"),
+                       (cli_args.workers_max,
+                        "MYTHRIL_TPU_SERVE_WORKERS_MAX"),
+                       (cli_args.queue_max,
+                        "MYTHRIL_TPU_SERVE_QUEUE_MAX")):
+        if flag is not None:
+            os.environ[knob] = str(flag)
     service = AnalysisService(
         solver=cli_args.solver, engine=cli_args.engine,
         strategy=cli_args.strategy,
@@ -317,6 +345,7 @@ def _cmd_serve(cli_args) -> int:
         fleet=True if cli_args.fleet else None,
         workers=cli_args.workers,
         inject_fault=cli_args.inject_fault)
+    install_sigterm_drain(service)
     if cli_args.stdio:
         from ..serve.daemon import serve_stdio
 
@@ -358,9 +387,13 @@ def _cmd_client(parser, cli_args) -> int:
             payload["engine"] = cli_args.engine
         if cli_args.deadline_ms:
             payload["deadline_ms"] = cli_args.deadline_ms
+        if cli_args.priority:
+            payload["priority"] = cli_args.priority
     try:
-        reply = serve_client.request(payload, socket_path=cli_args.socket,
-                                     timeout=cli_args.timeout)
+        reply = serve_client.request_with_retry(
+            payload, socket_path=cli_args.socket,
+            timeout=cli_args.timeout,
+            attempts=max(1, cli_args.retries))
     except serve_client.ServeClientError as error:
         print(f"myth-tpu client: {error}", file=sys.stderr)
         return 2
@@ -452,6 +485,18 @@ def main(argv=None) -> int:
     client.add_argument("--deadline-ms", type=int, default=None,
                         help="per-request analysis deadline (the daemon "
                              "returns a partial report when it expires)")
+    client.add_argument("--priority", default=None,
+                        choices=["interactive", "bulk"],
+                        help="admission class (default interactive): "
+                             "bulk work absorbs shedding under overload "
+                             "and yields the engine to interactive "
+                             "arrivals")
+    client.add_argument("--retries", type=int, default=1, metavar="N",
+                        help="total attempts for retryable failures "
+                             "(connection reset/refused, busy, "
+                             "overloaded) with jittered exponential "
+                             "backoff honoring the daemon's "
+                             "retry_after_ms hint (default 1: no retry)")
     client.add_argument("--id", default=None, help="request id to echo")
     client.add_argument("--socket", default=None, metavar="PATH",
                         help="daemon socket path (default: "

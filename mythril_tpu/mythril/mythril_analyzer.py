@@ -40,6 +40,9 @@ class MythrilAnalyzer:
         self.max_depth = getattr(cmd, "max_depth", 128)
         self.engine = getattr(cmd, "engine", "host") or "host"
         self.fleet = getattr(cmd, "fleet", False)
+        # optional threading.Event set by the serve batcher: preempts
+        # every member of this fleet run (QoS — see serve/service.py)
+        self.fleet_preempt = getattr(cmd, "fleet_preempt", None)
         self.checkpoint_path = getattr(cmd, "checkpoint", None)
         self.resume_path = getattr(cmd, "resume", None)
         self.disable_dependency_pruning = getattr(
@@ -248,7 +251,7 @@ class MythrilAnalyzer:
                 zip(self.contracts, contract_ids)):
             member = FleetMember(index, cid,
                                  execution_timeout=self.execution_timeout
-                                 or 0)
+                                 or 0, preempt=self.fleet_preempt)
             member.work = self._make_member_work(member, contract, modules,
                                                  transaction_count)
             members.append(member)

@@ -137,6 +137,20 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("cache.verdict.evicted", COUNTER, "1",
                "Sidecar verdict entries evicted by the "
                "MYTHRIL_TPU_VERDICT_SIDECAR_MAX bound."),
+    # -- content-addressed result store (serve/result_store.py) ------------------
+    MetricSpec("cache.result.hits", COUNTER, "1",
+               "Analyze requests answered from the content-addressed "
+               "result store at admission (zero worker dispatches)."),
+    MetricSpec("cache.result.misses", COUNTER, "1",
+               "Analyze requests whose (bytecode, config) key was not "
+               "in the result store."),
+    MetricSpec("cache.result.stored", COUNTER, "1",
+               "Complete analysis payloads persisted into the result "
+               "store (incomplete and quarantined results are never "
+               "cached)."),
+    MetricSpec("cache.result.evicted", COUNTER, "1",
+               "Result-store entries evicted by the "
+               "MYTHRIL_TPU_RESULT_STORE_MAX bound."),
     # -- device frontier (parallel/frontier.py) ----------------------------------
     MetricSpec("frontier.chunks", COUNTER, "1",
                "Fused lockstep chunks dispatched to the device."),
@@ -302,6 +316,40 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("serve.fleet.windows", COUNTER, "1",
                "Fleet micro-batch windows closed (one shared fleet run "
                "each, leader request included)."),
+    MetricSpec("serve.fleet.preempted", COUNTER, "1",
+               "Bulk fleet-batch members preempted mid-flight by an "
+               "interactive arrival: deadline-drained to their "
+               "namespaced checkpoint and re-enqueued, never aborted."),
+    # -- overload resilience (serve/admission.py, serve/autoscale.py) ------------
+    MetricSpec("serve.queue.depth", GAUGE, "requests",
+               "Requests waiting in the bounded priority admission "
+               "queue (both classes), sampled at every transition."),
+    MetricSpec("serve.queue.wait_ms", HISTOGRAM, "ms",
+               "Admission-queue wait from enqueue to execution grant "
+               "(label = priority class)."),
+    MetricSpec("serve.shed.overload", COUNTER, "1",
+               "Requests shed with a typed `overloaded` error because "
+               "the admission queue passed its high-water mark."),
+    MetricSpec("serve.shed.deadline", COUNTER, "1",
+               "Requests rejected at admission by deadline triage: the "
+               "deadline could not be met given queue depth x observed "
+               "p95 service time."),
+    MetricSpec("serve.shed.by_class", HISTOGRAM, "1",
+               "Shed/triaged requests by priority class (label = "
+               "interactive / bulk; the load harness asserts the "
+               "interactive count stays zero)."),
+    MetricSpec("serve.drain.shed", COUNTER, "1",
+               "Queued requests shed with `shutting_down` by the "
+               "graceful drain at shutdown."),
+    MetricSpec("serve.autoscale.target", GAUGE, "workers",
+               "Worker count the autoscaler currently wants (between "
+               "MYTHRIL_TPU_SERVE_WORKERS_MIN and _MAX)."),
+    MetricSpec("serve.autoscale.scale_ups", COUNTER, "1",
+               "Autoscaler scale-up events (sustained backlog grew the "
+               "pool by one warm worker)."),
+    MetricSpec("serve.autoscale.scale_downs", COUNTER, "1",
+               "Autoscaler scale-down events (sustained idle retired "
+               "one worker)."),
     # -- serve worker-process pool (mythril_tpu/serve/supervisor.py) -------------
     MetricSpec("serve.worker.spawns", COUNTER, "1",
                "Worker processes spawned by the serve supervisor "

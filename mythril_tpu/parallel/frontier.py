@@ -2191,13 +2191,18 @@ class FleetMember:
     """One contract's analysis job inside a fleet."""
 
     def __init__(self, index: int, contract_id: str, work=None,
-                 execution_timeout: int = 0):
+                 execution_timeout: int = 0, preempt=None):
         self.index = index
         self.contract_id = contract_id
         #: the whole per-contract analysis (SymExecWrapper + detector
         #: harvest), supplied by the analyzer; runs on this member's thread
         self.work = work
         self.execution_timeout = execution_timeout
+        #: optional threading.Event: when set (e.g. by the serve batcher
+        #: on an interactive arrival), this member's budget reads as
+        #: exhausted and the next deadline_drain sweep abandons it — it
+        #: checkpoints what it has and yields the device (QoS preemption)
+        self.preempt = preempt
         self.driver: Optional["FleetDriver"] = None
         self.laser = None        # set by SymExecWrapper(fleet=member)
         self.gate_laser = None   # laser parked at the device gate
@@ -2230,8 +2235,10 @@ class FleetMember:
 
     def budget_remaining(self) -> float:
         """Seconds left in this member's own execution budget (inf when
-        untimed). Mirrors svm._exec_pass: total wall since the member's
-        transaction phase began."""
+        untimed, 0 when preempted). Mirrors svm._exec_pass: total wall
+        since the member's transaction phase began."""
+        if self.preempt is not None and self.preempt.is_set():
+            return 0.0
         laser = self.gate_laser or self.laser
         timeout = getattr(laser, "execution_timeout", 0) if laser \
             else self.execution_timeout

@@ -25,6 +25,27 @@ from ..support import tpu_config
 log = logging.getLogger(__name__)
 
 
+def install_sigterm_drain(service) -> None:
+    """SIGTERM → graceful drain instead of a hard kill: admission stops
+    (new analyzes get a typed ``shutting_down``), the transport loop
+    exits, and ``service.shutdown()`` runs the
+    ``MYTHRIL_TPU_SERVE_DRAIN_MS`` drain — in-flight and queued
+    interactive work finishes, queued bulk is shed, stragglers are
+    preempted into checkpoints. No-op off the main thread or on
+    platforms without signals."""
+    import signal
+
+    def _drain(signum, frame):
+        log.info("SIGTERM — draining")
+        slog.event("serve.sigterm")
+        service.shutting_down.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _drain)
+    except (ValueError, OSError, AttributeError, RuntimeError):
+        pass
+
+
 def default_socket_path() -> str:
     """MYTHRIL_TPU_SERVE_SOCKET, or ~/.mythril_tpu/serve.sock."""
     configured = tpu_config.get_str("MYTHRIL_TPU_SERVE_SOCKET")

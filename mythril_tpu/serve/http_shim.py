@@ -3,11 +3,14 @@
 ``POST /`` with one protocol request object as the JSON body returns the
 reply as the JSON response body — the same validation, admission, and
 isolation as the socket path, because every request still goes through
-``AnalysisService.handle``. ``GET /healthz`` answers a metrics
-summary (uptime, request counters, warm buckets, frontier telemetry
-rollup) and ``GET /metrics`` answers Prometheus text exposition
-(observe/export.py) — both without touching the engine, so a scrape
-during a long analyze never blocks. This is deliberately a shim, not a web framework:
+``AnalysisService.handle``. Overload semantics ride standard HTTP: an
+``overloaded`` shed maps to 429 with a ``Retry-After`` header (rounded
+up from the reply's ``retry_after_ms``), ``shutting_down`` to 503.
+``GET /healthz`` answers a metrics summary (uptime, request counters,
+queue depths, autoscaler state, warm buckets, frontier telemetry
+rollup), ``GET /status`` the full status rollup, and ``GET /metrics``
+Prometheus text exposition (observe/export.py) — all without touching
+the engine, so a scrape during a long analyze never blocks. This is deliberately a shim, not a web framework:
 stdlib ``http.server`` only, one process, no TLS — put a real proxy in
 front if this ever leaves localhost.
 """
@@ -33,11 +36,14 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route access logs to logging
         log.debug("http: " + fmt, *args)
 
-    def _reply(self, status: int, payload: dict) -> None:
+    def _reply(self, status: int, payload: dict,
+               retry_after_s: Optional[int] = None) -> None:
         body = json.dumps(payload, sort_keys=True).encode("utf-8")
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if retry_after_s is not None:
+            self.send_header("Retry-After", str(retry_after_s))
         self.end_headers()
         self.wfile.write(body)
 
@@ -56,6 +62,11 @@ class _Handler(BaseHTTPRequestHandler):
                 protocol.Request("healthz", "healthz", {}))
             self._reply(200, reply)
             return
+        if self.path == "/status":
+            reply = self.service.handle(
+                protocol.Request("status", "status", {}))
+            self._reply(200, reply)
+            return
         if self.path == "/metrics":
             # Prometheus scrape: text exposition, not a JSON envelope
             reply = self.service.handle(
@@ -64,7 +75,8 @@ class _Handler(BaseHTTPRequestHandler):
                              reply["content_type"])
             return
         self._reply(404, protocol.error_reply(
-            None, "bad_request", "GET supports /healthz and /metrics"))
+            None, "bad_request",
+            "GET supports /healthz, /status, and /metrics"))
 
     def do_POST(self):
         try:
@@ -88,15 +100,25 @@ class _Handler(BaseHTTPRequestHandler):
                 error.request_id, error.code, error.message))
             return
         reply = self.service.handle(request)
+        retry_after_s: Optional[int] = None
         if reply.get("ok"):
             status = 200
         elif reply["error"]["code"] == "busy":
             status = 429  # Too Many Requests: back off and retry
+        elif reply["error"]["code"] == "overloaded":
+            status = 429  # shed by admission control
+            retry_ms = reply["error"].get("retry_after_ms")
+            if isinstance(retry_ms, (int, float)) and retry_ms > 0:
+                # Retry-After is whole seconds; round up so a client
+                # honoring the header never retries early
+                retry_after_s = max(1, -(-int(retry_ms) // 1000))
+        elif reply["error"]["code"] == "shutting_down":
+            status = 503  # draining: this daemon is going away
         elif reply["error"]["code"] == "quarantined":
             status = 409  # Conflict: the resource itself is refused
         else:
             status = 400
-        self._reply(status, reply)
+        self._reply(status, reply, retry_after_s=retry_after_s)
 
 
 def serve_http(service, host: str = "127.0.0.1", port: int = 8551,

@@ -24,10 +24,19 @@ the request ``id`` (auto-assigned ``req-N`` when absent) and carry either
 
 Error codes: ``line_too_long``, ``bad_json``, ``bad_request``,
 ``unknown_op``, ``busy`` (in-flight bound reached — retry later),
-``shutting_down``, ``analysis_failed``, ``quarantined`` (this bytecode
-has repeatedly killed worker processes and is refused at admission —
-see serve/quarantine.py). Validation failures never kill the
-connection: the daemon replies with the error and keeps reading.
+``overloaded`` (shed by admission control — the queue is past its
+high-water mark or the deadline cannot be met at current depth; the
+error object carries ``retry_after_ms``, a backoff hint scaled by
+observed p95 service time — see serve/admission.py), ``shutting_down``,
+``analysis_failed``, ``quarantined`` (this bytecode has repeatedly
+killed worker processes and is refused at admission — see
+serve/quarantine.py). Validation failures never kill the connection:
+the daemon replies with the error and keeps reading.
+
+``priority`` classes every analyze request for admission and fleet
+batch composition: ``interactive`` (the default — latency-sensitive,
+dequeues first, never shed while bulk work is queued) or ``bulk``
+(throughput traffic that absorbs shedding under overload).
 
 ``deadline_ms`` rides the engine's existing deadline-drain substrate: it
 becomes the analysis execution timeout, so an over-deadline request
@@ -49,6 +58,9 @@ OPS = ("analyze", "ping", "status", "shutdown", "healthz", "metrics")
 
 STRATEGIES = ("dfs", "bfs", "naive-random", "weighted-random",
               "beam-search", "pending")
+
+#: admission classes, best-first (see serve/admission.py)
+PRIORITIES = ("interactive", "bulk")
 
 #: one day, matching the CLI's --execution-timeout default ceiling
 MAX_DEADLINE_MS = 86_400_000
@@ -182,6 +194,11 @@ def parse_request(line) -> Request:
              and 1 <= max_depth <= 4096,
              "max_depth must be an integer in [1, 4096]", request_id)
     params["max_depth"] = max_depth
+
+    priority = doc.get("priority", "interactive")
+    _require(priority in PRIORITIES,
+             f"priority must be one of {PRIORITIES}", request_id)
+    params["priority"] = priority
 
     return Request("analyze", request_id, params)
 

@@ -1,12 +1,20 @@
 """AnalysisService: the daemon's request executor.
 
-One instance owns the warm set, the admission gate, and the engine lock;
-the stdio loop, the unix-socket server, and the HTTP shim all funnel
-into :meth:`handle`, so every transport shares one behavior:
+One instance owns the warm set, the admission queue, and the engine
+lock; the stdio loop, the unix-socket server, and the HTTP shim all
+funnel into :meth:`handle`, so every transport shares one behavior:
 
-* **Admission** is bounded by ``MYTHRIL_TPU_SERVE_MAX_INFLIGHT``: a
-  request beyond the bound is answered ``busy`` immediately (counted in
-  ``serve.busy_rejections``) instead of queueing unboundedly.
+* **Admission** is a bounded two-class priority queue
+  (serve/admission.py): ``MYTHRIL_TPU_SERVE_MAX_INFLIGHT`` execution
+  grants, up to ``MYTHRIL_TPU_SERVE_QUEUE_MAX`` waiting requests
+  ordered (priority, deadline, arrival). Past the high-water mark the
+  lowest-priority oldest waiter is shed with a typed ``overloaded``
+  error carrying ``retry_after_ms``; a request whose deadline cannot
+  be met given queue depth × observed p95 service time is refused at
+  admission (early triage). Before any of that, a repeat
+  (bytecode, config) request is answered straight from the
+  content-addressed result store (serve/result_store.py) without
+  consuming a grant or touching a worker.
 * **Execution** is serialized on one engine lock — the symbolic engine,
   the solver pipeline, and the dispatch queue are all single-threaded
   process singletons. Admitted requests wait on the lock; the in-flight
@@ -33,19 +41,37 @@ into :meth:`handle`, so every transport shares one behavior:
   victim request is retried once, and repeat offenders land in the
   poison-quarantine sidecar (answered with a typed ``quarantined``
   error). The engine lock is bypassed in this mode: the pool itself is
-  the execution-capacity gate.
+  the execution-capacity gate. With ``MYTHRIL_TPU_SERVE_WORKERS_MAX``
+  set, an autoscaler (serve/autoscale.py) elastically resizes the pool
+  from the admission-depth and occupancy gauges.
+* **QoS preemption** (fleet mode): the micro-batcher composes batches
+  in (priority, deadline, arrival) order, and an interactive arrival
+  preempts a running all-bulk batch through the engine's per-contract
+  deadline-drain machinery — the preempted members checkpoint
+  (namespaced per contract) and re-run solo from their checkpoints
+  instead of being aborted.
+* **Graceful drain**: ``shutdown``/SIGTERM stops admission (typed
+  ``shutting_down``), sheds queued bulk work, and gives in-flight and
+  queued-interactive requests ``MYTHRIL_TPU_SERVE_DRAIN_MS`` to finish
+  before the remaining fleet batches are preempted into checkpoints.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
+import os
+import shutil
+import tempfile
 import threading
 import time
 from typing import Dict, Optional
 
 from . import protocol
-from .quarantine import QuarantinedContract
+from .admission import AdmissionQueue, Overloaded
+from .quarantine import QuarantinedContract, contract_key
+from .result_store import ResultStore, result_key, results_path_for
 from .warmset import WarmSet
 from ..observe import export, metrics, slog, trace
 from ..support import tpu_config
@@ -85,15 +111,33 @@ class _RequestArgs:
     every field with a default, so only overrides need to exist)."""
 
 
+#: batch-composition order: priority class first, then deadline
+_PRIORITY_RANK = {name: rank
+                  for rank, name in enumerate(protocol.PRIORITIES)}
+
+
 class _FleetTicket:
     """One analyze request waiting on (or leading) a fleet micro-batch."""
+
+    _seq = itertools.count(1)
 
     def __init__(self, params: Dict, cid: str):
         self.params = params
         self.cid = cid
+        self.seq = next(self._seq)
         self.done = threading.Event()
         self.payload: Optional[Dict] = None
         self.error: Optional[BaseException] = None
+        #: set when this member was preempted by an interactive arrival:
+        #: the request thread re-runs it solo from `resume_path`
+        self.preempted = False
+        self.resume_path: Optional[str] = None
+
+    def sort_key(self):
+        deadline = self.params.get("deadline_ms") or float("inf")
+        return (_PRIORITY_RANK.get(
+            self.params.get("priority") or "interactive", 0),
+            deadline, self.seq)
 
 
 class _FleetBatcher:
@@ -107,7 +151,14 @@ class _FleetBatcher:
     (MythrilAnalyzer.fleet_contract_results — one shared device frontier,
     merged solver flushes) and demuxes per-contract results back into
     per-request replies. Followers just park on their ticket. Requests
-    whose parameters differ (another key) lead their own batch."""
+    whose parameters differ (another key) lead their own batch.
+
+    QoS: the leader composes the batch in (priority, deadline, arrival)
+    order, and an interactive arrival at admission preempts any running
+    all-bulk batch (``preempt_for_interactive``) via the engine's
+    deadline-drain machinery — preempted members checkpoint under a
+    batch-scoped namespace and their request threads re-run them solo
+    from the checkpoint once the interactive work has the engine."""
 
     #: params that must agree for two requests to share one fleet step
     _KEY_FIELDS = ("engine", "solver", "strategy", "max_depth",
@@ -117,6 +168,11 @@ class _FleetBatcher:
         self.service = service
         self._lock = threading.Lock()
         self._waiting: Dict[tuple, list] = {}
+        self._batch_seq = itertools.count(1)
+        #: running engine-lock batches: {"preempt": Event, "tickets": []}
+        #: (worker-mode batches are not preemptible across the process
+        #: boundary — the pool's parallelism is their QoS lever)
+        self._inflight: list = []
 
     def _key(self, params: Dict) -> tuple:
         key = [params.get(field) for field in self._KEY_FIELDS]
@@ -145,6 +201,9 @@ class _FleetBatcher:
                 time.sleep(window_s)
             with self._lock:
                 group = self._waiting.pop(key)
+            # batch composition is (priority, deadline, arrival), so a
+            # mixed batch runs its interactive members first
+            group.sort(key=_FleetTicket.sort_key)
             if self.service._supervisor is not None:
                 # worker mode: the batch runs in a supervised worker
                 # process; the pool is the capacity gate, not the
@@ -154,9 +213,58 @@ class _FleetBatcher:
                 with self.service._engine_lock:
                     self._run_batch(group)
         ticket.done.wait()
+        if ticket.preempted:
+            return self._rerun_preempted(ticket)
         if ticket.error is not None:
             raise ticket.error
         return ticket.payload
+
+    def preempt_for_interactive(self) -> int:
+        """Preempt every running all-bulk batch (an interactive request
+        just arrived and wants the engine): sets the batch's preempt
+        event, so the next deadline-drain sweep abandons its members —
+        they checkpoint and re-run solo. Returns batches preempted."""
+        with self._lock:
+            batches = list(self._inflight)
+        hit = 0
+        for batch in batches:
+            if batch["preempt"].is_set():
+                continue
+            if all((t.params.get("priority") or "interactive") == "bulk"
+                   for t in batch["tickets"]):
+                batch["preempt"].set()
+                hit += 1
+                metrics.inc("serve.fleet.preempted")
+                slog.event("serve.fleet.preempt",
+                           members=len(batch["tickets"]))
+                log.info("preempting a running bulk fleet batch "
+                         "(%d member(s)) for an interactive arrival",
+                         len(batch["tickets"]))
+        return hit
+
+    def _rerun_preempted(self, ticket: _FleetTicket) -> Dict:
+        """The request thread's continuation after its member was
+        preempted: one solo engine-lock run, resuming from the member's
+        batch-scoped checkpoint when one was written (a drain before
+        the first periodic save restarts from scratch). Solo means no
+        batcher and no preempt event — a re-run cannot be preempted
+        again, so bulk work always completes."""
+        resume = ticket.resume_path
+        if resume and not os.path.exists(resume):
+            resume = None
+        slog.event("serve.fleet.requeued", resume=bool(resume))
+        try:
+            with self.service._engine_lock:
+                payload = self.service._run_analysis_local(
+                    ticket.params, resume_path=resume)
+        finally:
+            if ticket.resume_path:
+                try:
+                    os.unlink(ticket.resume_path)
+                except OSError:
+                    pass
+        payload["fleet_preempted"] = True
+        return payload
 
     def _run_batch(self, group: list) -> None:
         """Leader-side: run every ticket's contract as one fleet and
@@ -232,11 +340,19 @@ class _FleetBatcher:
         reset_solver_backend(keep_verdicts=True)
         reset_callback_modules()
         params = group[0].params
+        preempt = threading.Event()
+        ckpt_base = os.path.join(self.service._fleet_ckpt_dir(),
+                                 f"fleet-{next(self._batch_seq)}")
         cmd = _RequestArgs()
         cmd.solver = params.get("solver") or self.service.solver
         cmd.engine = params.get("engine") or self.service.engine
         cmd.max_depth = params["max_depth"]
         cmd.fleet = True
+        cmd.fleet_preempt = preempt
+        # batch-scoped checkpoint namespace: each member periodically
+        # saves to {base}.{contract_id}, which is exactly what a
+        # preempted member's solo re-run resumes from
+        cmd.checkpoint = ckpt_base
         cmd.execution_timeout = execution_timeout_s(
             params.get("deadline_ms"))
         disassembler = MythrilDisassembler()
@@ -257,10 +373,29 @@ class _FleetBatcher:
             disassembler, cmd_args=cmd,
             strategy=params.get("strategy") or self.service.strategy,
             address=address)
-        results = analyzer.fleet_contract_results(
-            modules=params.get("modules"),
-            transaction_count=params["transaction_count"])
+        batch = {"preempt": preempt,
+                 "tickets": [ticket for ticket, _ in live]}
+        with self._lock:
+            self._inflight.append(batch)
+        try:
+            results = analyzer.fleet_contract_results(
+                modules=params.get("modules"),
+                transaction_count=params["transaction_count"])
+        finally:
+            with self._lock:
+                if batch in self._inflight:
+                    self._inflight.remove(batch)
+        preempted = preempt.is_set() \
+            and not self.service.shutting_down.is_set()
         for (ticket, contract), entry in zip(live, results):
+            if preempted and entry["timed_out"]:
+                # preempted mid-flight: hand the member back to its own
+                # request thread to re-run solo from its checkpoint —
+                # re-enqueue, not abort
+                ticket.preempted = True
+                ticket.resume_path = f"{ckpt_base}.{entry['contract_id']}"
+                ticket.done.set()
+                continue
             report = Report(contracts=[contract],
                             exceptions=entry["exceptions"])
             report.source = [getattr(contract, "input_file", contract.name)]
@@ -314,8 +449,24 @@ class AnalysisService:
                 solver=self.solver, engine=self.engine,
                 strategy=self.strategy, warmup=self.warmup_enabled,
                 inject_fault=inject_fault)
-        self._gate = threading.BoundedSemaphore(self.max_inflight)
+        self._admission = AdmissionQueue(self.max_inflight)
+        # the result sidecar lives beside the warmset manifest, so the
+        # store follows the manifest: no manifest, no result store (a
+        # memory-only cache would silently diverge between daemons)
+        self.result_store: Optional[ResultStore] = None
+        if manifest_path and tpu_config.get_flag("MYTHRIL_TPU_RESULT_STORE"):
+            self.result_store = ResultStore(
+                path=results_path_for(manifest_path),
+                quarantine=(self._supervisor.quarantine
+                            if self._supervisor is not None else None))
+        self._autoscaler = None
+        if self._supervisor is not None:
+            from .autoscale import Autoscaler
+
+            self._autoscaler = Autoscaler(self._supervisor,
+                                          self._admission)
         self._engine_lock = threading.Lock()
+        self._fleet_workdir: Optional[str] = None
         self._started = time.monotonic()
         self._requests_done = 0
         self.shutting_down = threading.Event()
@@ -340,13 +491,46 @@ class AnalysisService:
         elif self.warmup_enabled:
             self.warmset.warmup()
             self.warmset.record_observed()
+        if self._autoscaler is not None:
+            self._autoscaler.start()
 
-    def shutdown(self) -> None:
+    def shutdown(self, drain_ms: Optional[int] = None) -> None:
+        """Graceful drain, then stop: admission closes (new analyzes get
+        ``shutting_down``), queued *bulk* work is shed, in-flight and
+        queued-interactive requests get ``MYTHRIL_TPU_SERVE_DRAIN_MS``
+        to finish, and whatever is still running after the budget is
+        preempted into its checkpoints instead of being cut."""
+        if drain_ms is None:
+            drain_ms = tpu_config.get_int("MYTHRIL_TPU_SERVE_DRAIN_MS")
         self.shutting_down.set()
+        if self._autoscaler is not None:
+            self._autoscaler.stop()
+        self._admission.close()
+        shed = self._admission.shed_class("bulk")
+        slog.event("serve.drain", drain_ms=drain_ms, bulk_shed=shed)
+        drained = self._admission.wait_idle(max(0, drain_ms) / 1000.0)
+        if not drained:
+            log.warning("drain budget (%d ms) expired with work still "
+                        "in flight — preempting into checkpoints",
+                        drain_ms)
+            if self._fleet_batcher is not None:
+                with self._fleet_batcher._lock:
+                    batches = list(self._fleet_batcher._inflight)
+                for batch in batches:
+                    batch["preempt"].set()
         if self._supervisor is not None:
             self._supervisor.stop()
         self.warmset.record_observed()
         trace.export()
+        if self._fleet_workdir is not None:
+            shutil.rmtree(self._fleet_workdir, ignore_errors=True)
+            self._fleet_workdir = None
+
+    def _fleet_ckpt_dir(self) -> str:
+        if self._fleet_workdir is None:
+            self._fleet_workdir = tempfile.mkdtemp(
+                prefix="myth-tpu-fleet-ckpt-")
+        return self._fleet_workdir
 
     def uptime_s(self) -> float:
         return time.monotonic() - self._started
@@ -376,28 +560,42 @@ class AnalysisService:
             self.shutting_down.set()
             return protocol.ok_reply(request.id, shutdown=True,
                                      requests_served=self._requests_done)
-        # analyze: bounded admission, serialized execution. The
-        # correlation id is minted here, at admission — a busy bounce
-        # gets one too, so its log line and reply still correlate.
+        # analyze: result-store short-circuit, then queued admission,
+        # then execution. The correlation id is minted here, at
+        # admission — a shed reply gets one too, so its log line and
+        # reply still correlate.
         cid = slog.new_correlation_id()
-        if not self._gate.acquire(blocking=False):
+        params = request.params
+        priority = params.get("priority") or "interactive"
+        cached = self._cached_reply(request, cid)
+        if cached is not None:
+            return cached
+        if self._fleet_batcher is not None and priority == "interactive":
+            # an interactive arrival evicts running all-bulk batches
+            # BEFORE queueing, so the grant it waits on frees promptly
+            self._fleet_batcher.preempt_for_interactive()
+        try:
+            self._admission.acquire(priority, params.get("deadline_ms"))
+        except Overloaded as shed:
             with slog.correlated(cid):
                 metrics.inc("serve.requests")
                 metrics.inc("serve.busy_rejections")
-                slog.event("serve.busy", request_id=str(request.id),
-                           max_inflight=self.max_inflight)
-            reply = protocol.error_reply(
-                request.id, "busy",
-                f"{self.max_inflight} requests already in flight")
+                slog.event("serve.shed", request_id=str(request.id),
+                           priority=priority, reason=shed.reason,
+                           retry_after_ms=shed.retry_after_ms)
+            code = ("shutting_down" if shed.reason == "shutting_down"
+                    else "overloaded")
+            reply = protocol.error_reply(request.id, code, str(shed))
+            if code == "overloaded":
+                reply["error"]["retry_after_ms"] = shed.retry_after_ms
             reply["correlation_id"] = cid
             return reply
         try:
             with slog.correlated(cid):
                 slog.event("serve.admitted", request_id=str(request.id),
-                           op=request.op)
+                           op=request.op, priority=priority)
                 if self._fleet_batcher is not None and \
-                        (request.params.get("engine")
-                         or self.engine) == "tpu":
+                        (params.get("engine") or self.engine) == "tpu":
                     # micro-batching path: the batch LEADER takes the
                     # engine lock for the whole fleet step; followers
                     # park on their ticket instead of queueing here
@@ -410,7 +608,29 @@ class AnalysisService:
                 with self._engine_lock:
                     return self._analyze(request, cid)
         finally:
-            self._gate.release()
+            self._admission.release()
+
+    def _cached_reply(self, request, cid: str) -> Optional[Dict]:
+        """Content-addressed short-circuit: a repeat (bytecode, config)
+        request is answered from the result store before admission —
+        zero queueing, zero worker dispatch (the cheapest shedding)."""
+        if self.result_store is None:
+            return None
+        params = request.params
+        key = result_key(params, solver=self.solver, engine=self.engine,
+                         strategy=self.strategy)
+        payload = self.result_store.get(
+            key, contract_hash=contract_key(params.get("code")))
+        if payload is None:
+            return None
+        with slog.correlated(cid):
+            metrics.inc("serve.requests")
+            self._requests_done += 1
+            slog.event("serve.reply", request_id=str(request.id),
+                       ok=True, cached=True,
+                       issues=payload.get("issue_count", 0))
+        return protocol.ok_reply(request.id, correlation_id=cid,
+                                 cached=True, elapsed_ms=0.0, **payload)
 
     def _healthz(self, request) -> Dict:
         """Liveness probe with a metrics summary (GET /healthz): uptime,
@@ -432,6 +652,11 @@ class AnalysisService:
                       int(metrics.value("cache.verdict.loaded")),
                   "warmset": self.warmset.status()},
             frontier=_frontier_counters(),
+            queue=self._admission.status(),
+            autoscaler=(self._autoscaler.status()
+                        if self._autoscaler is not None else None),
+            result_store=(self.result_store.status()
+                          if self.result_store is not None else None),
             workers=(self._supervisor.status()
                      if self._supervisor is not None else None))
 
@@ -460,6 +685,11 @@ class AnalysisService:
             solver=self.solver, engine=self.engine,
             fleet=self.fleet,
             max_inflight=self.max_inflight,
+            queue=self._admission.status(),
+            autoscaler=(self._autoscaler.status()
+                        if self._autoscaler is not None else None),
+            result_store=(self.result_store.status()
+                          if self.result_store is not None else None),
             warmset=self.warmset.status(),
             workers=(self._supervisor.status()
                      if self._supervisor is not None else None),
@@ -523,6 +753,13 @@ class AnalysisService:
         metrics.inc("serve.requests")
         metrics.observe("serve.request_ms", elapsed_ms)
         self._requests_done += 1
+        if self.result_store is not None:
+            # put() itself refuses incomplete payloads and quarantined
+            # hashes — a deadline-drained partial must never be replayed
+            self.result_store.put(
+                result_key(params, solver=self.solver,
+                           engine=self.engine, strategy=self.strategy),
+                payload, contract_hash=contract_key(params.get("code")))
         self.warmset.record_observed()
         # one snapshot-ring tick per finished request: the "periodic"
         # cadence of a daemon is its request stream

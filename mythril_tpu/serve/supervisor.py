@@ -202,6 +202,7 @@ class Supervisor:
         self._idle: "queue.Queue[_WorkerHandle]" = queue.Queue()
         self._handles = [_WorkerHandle(slot)
                          for slot in range(self.workers)]
+        self._slot_seq = itertools.count(self.workers)
         self._seq = itertools.count(1)
         self._stopping = threading.Event()
         self._workdir = tempfile.mkdtemp(prefix="myth-tpu-serve-ckpt-")
@@ -378,6 +379,85 @@ class Supervisor:
         with self._lock:
             return sum(1 for h in self._handles
                        if h.state in (WARM, BUSY))
+
+    # -- elastic scaling ---------------------------------------------------------------
+
+    def occupancy(self) -> Dict[str, int]:
+        """Busy/live worker counts — the autoscaler's load signal."""
+        with self._lock:
+            busy = sum(1 for h in self._handles if h.state == BUSY)
+            live = sum(1 for h in self._handles
+                       if h.state in (WARM, BUSY))
+        return {"busy": busy, "live": live}
+
+    def scale_to(self, target: int) -> int:
+        """Elastically resize the pool toward `target` slots (the
+        autoscaler's lever). Growth spawns new slots immediately — they
+        come up warm through the durable exec/verdict caches, not a
+        cold compile. Shrink only retires *idle* workers: a busy worker
+        is never killed mid-job, so when fewer idle workers are parked
+        than the deficit, the remainder retires on a later tick.
+        Returns the pool size after this call."""
+        target = max(1, int(target))
+        if self._stopping.is_set():
+            return self.workers
+        with self._lock:
+            current = sum(1 for h in self._handles if h.state != STOPPED)
+        while current < target:
+            with self._lock:
+                handle = _WorkerHandle(next(self._slot_seq))
+                self._handles.append(handle)
+            self._respawn_async(handle, delay_s=0.0, restart=False)
+            current += 1
+        while current > target:
+            try:
+                handle = self._idle.get_nowait()
+            except queue.Empty:
+                break  # nothing idle to retire — retry next tick
+            if handle.proc is None or handle.proc.poll() is not None:
+                # a corpse parked idle: retiring it IS the shrink —
+                # count the death but do not respawn into a shrink
+                self._count_death(handle,
+                                  resilience.classify_exit_status(
+                                      handle.proc.returncode
+                                      if handle.proc else None)
+                                  or resilience.WORKER_CRASH,
+                                  "died while idle", job_id=None)
+            self._retire(handle)
+            current -= 1
+        with self._lock:
+            self.workers = max(
+                1, sum(1 for h in self._handles if h.state != STOPPED))
+            return self.workers
+
+    def _retire(self, handle: _WorkerHandle) -> None:
+        with self._lock:
+            handle.state = STOPPED
+            if handle in self._handles:
+                self._handles.remove(handle)
+            proc = handle.proc
+            pid = handle.pid
+            handle.proc = None
+            handle.reader = None
+            handle.pid = None
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.stdin.write(b'{"kind": "shutdown"}\n')
+                proc.stdin.flush()
+            except (OSError, ValueError):
+                pass
+            try:
+                proc.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        metrics.set_gauge("serve.worker.pool", self._live_count())
+        slog.event("serve.worker.retired", slot=handle.slot, pid=pid)
+        log.info("worker slot %d (pid %s) retired by scale-down",
+                 handle.slot, pid)
 
     def _backoff_for(self, handle: _WorkerHandle) -> float:
         exponent = max(handle.consecutive_deaths - 1, 0)

@@ -165,6 +165,43 @@ _KNOBS: List[Knob] = [
          "Worker deaths attributed to one bytecode hash before the "
          "contract lands in the poison-quarantine sidecar and further "
          "requests for it are refused with a `quarantined` error."),
+    # -- overload resilience (serve/admission.py, serve/autoscale.py) -------------
+    Knob("MYTHRIL_TPU_SERVE_QUEUE_MAX", "int", 16,
+         "Bounded admission-queue capacity (waiting requests across both "
+         "priority classes); past it the lowest-priority oldest waiter "
+         "is shed with a typed `overloaded` error carrying "
+         "retry_after_ms."),
+    Knob("MYTHRIL_TPU_SERVE_RETRY_AFTER_MS", "int", 1000,
+         "Base retry hint (ms) carried by `overloaded` shed replies; "
+         "scaled up with observed p95 service time and queue depth."),
+    Knob("MYTHRIL_TPU_SERVE_DRAIN_MS", "int", 5000,
+         "Graceful-drain budget (ms) at shutdown/SIGTERM: in-flight and "
+         "queued-interactive requests may finish within it; queued bulk "
+         "is shed immediately and anything still running past it is "
+         "preempted to its checkpoint."),
+    Knob("MYTHRIL_TPU_SERVE_WORKERS_MIN", "int", 0,
+         "Autoscale floor for the serve worker pool; 0 falls back to the "
+         "configured MYTHRIL_TPU_SERVE_WORKERS size."),
+    Knob("MYTHRIL_TPU_SERVE_WORKERS_MAX", "int", 0,
+         "Autoscale ceiling for the serve worker pool; 0 (the default) "
+         "disables autoscaling and keeps the pool fixed."),
+    Knob("MYTHRIL_TPU_SERVE_AUTOSCALE_INTERVAL_MS", "int", 500,
+         "Autoscaler sampling cadence (ms): each tick reads admission "
+         "queue depth and pool occupancy."),
+    Knob("MYTHRIL_TPU_SERVE_AUTOSCALE_UP_AFTER", "int", 2,
+         "Consecutive backlogged autoscaler ticks (queued work with the "
+         "whole pool busy) before one scale-up step."),
+    Knob("MYTHRIL_TPU_SERVE_AUTOSCALE_DOWN_AFTER", "int", 8,
+         "Consecutive idle autoscaler ticks (no queue, no busy worker) "
+         "before one scale-down step — the hysteresis that keeps a "
+         "bursty load from thrashing the pool."),
+    Knob("MYTHRIL_TPU_RESULT_STORE", "flag", True,
+         "Content-addressed result store: answer repeat (bytecode, "
+         "config) analyze requests from a persisted sidecar at "
+         "admission, without dispatching a worker; 0 disables."),
+    Knob("MYTHRIL_TPU_RESULT_STORE_MAX", "int", 4096,
+         "Max entries kept in the persisted result-store sidecar; "
+         "beyond it the oldest entries are evicted at save time."),
     # -- durable warmth (parallel/exec_cache.py, serve/warmset.py) ----------------
     Knob("MYTHRIL_TPU_EXEC_CACHE", "flag", True,
          "Persistent executable cache: serialize compiled solver runners "

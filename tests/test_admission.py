@@ -1,0 +1,353 @@
+"""Admission queue and autoscaler tests: two-class priority ordering,
+overload shedding, deadline triage, drain semantics, and the hysteresis
+control loop (driven tick-by-tick against fakes — no worker pool, no
+timer thread). Stdlib-only."""
+
+import threading
+import time
+
+import pytest
+
+from mythril_tpu.observe import metrics
+from mythril_tpu.serve.admission import (AdmissionQueue, Overloaded,
+                                         SERVICE_HISTOGRAM)
+from mythril_tpu.serve.autoscale import Autoscaler
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _acquire_in_thread(queue, priority="interactive", deadline_ms=None):
+    """Start an acquire on a thread; returns (thread, outcome dict)."""
+    outcome = {}
+
+    def run():
+        try:
+            outcome["waited_ms"] = queue.acquire(priority, deadline_ms)
+        except Overloaded as error:
+            outcome["error"] = error
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    return thread, outcome
+
+
+def _wait_for(predicate, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while not predicate():
+        assert time.monotonic() < deadline, "condition never held"
+        time.sleep(0.005)
+
+
+# -- grants and ordering -------------------------------------------------------------
+
+
+def test_acquire_release_grants_within_slots():
+    queue = AdmissionQueue(2, capacity=4)
+    assert queue.acquire() >= 0.0
+    assert queue.acquire() >= 0.0
+    assert queue.active() == 2
+    queue.release()
+    queue.release()
+    assert queue.active() == 0
+
+
+def test_try_acquire_never_queues():
+    queue = AdmissionQueue(1, capacity=4)
+    assert queue.try_acquire()
+    assert not queue.try_acquire()  # slot busy → False, not a wait
+    queue.release()
+    assert queue.try_acquire()
+    queue.release()
+
+
+def test_interactive_dequeues_before_earlier_bulk():
+    queue = AdmissionQueue(1, capacity=4)
+    queue.acquire()  # occupy the slot
+    order = []
+    bulk_thread, bulk = _acquire_in_thread(queue, "bulk")
+    _wait_for(lambda: queue.depths()["bulk"] == 1)
+    inter_thread, inter = _acquire_in_thread(queue, "interactive")
+    _wait_for(lambda: queue.depths()["interactive"] == 1)
+    # free the slot: the LATER interactive arrival must win it
+    queue.release()
+    _wait_for(lambda: "waited_ms" in inter)
+    assert queue.depths()["bulk"] == 1  # bulk still parked
+    order.append("interactive")
+    queue.release()
+    _wait_for(lambda: "waited_ms" in bulk)
+    order.append("bulk")
+    queue.release()
+    bulk_thread.join(timeout=5)
+    inter_thread.join(timeout=5)
+    assert order == ["interactive", "bulk"]
+
+
+def test_earlier_deadline_wins_within_class():
+    queue = AdmissionQueue(1, capacity=4)
+    queue.acquire()
+    late_thread, late = _acquire_in_thread(queue, "bulk", deadline_ms=60_000)
+    _wait_for(lambda: queue.depths()["bulk"] == 1)
+    soon_thread, soon = _acquire_in_thread(queue, "bulk", deadline_ms=1_000)
+    _wait_for(lambda: queue.depths()["bulk"] == 2)
+    queue.release()
+    _wait_for(lambda: "waited_ms" in soon)
+    assert "waited_ms" not in late
+    queue.release()
+    _wait_for(lambda: "waited_ms" in late)
+    queue.release()
+    late_thread.join(timeout=5)
+    soon_thread.join(timeout=5)
+
+
+# -- overload shedding ---------------------------------------------------------------
+
+
+def test_bulk_flood_sheds_oldest_bulk_never_interactive():
+    queue = AdmissionQueue(1, capacity=2, retry_after_ms=100)
+    queue.acquire()
+    inter_thread, inter = _acquire_in_thread(queue, "interactive")
+    _wait_for(lambda: queue.depths()["interactive"] == 1)
+    old_bulk_thread, old_bulk = _acquire_in_thread(queue, "bulk")
+    _wait_for(lambda: queue.depths()["bulk"] == 1)
+    new_bulk_thread, new_bulk = _acquire_in_thread(queue, "bulk")
+    # over capacity: the OLDEST bulk waiter is shed with a retry hint
+    _wait_for(lambda: "error" in old_bulk)
+    assert old_bulk["error"].reason == "overload"
+    assert old_bulk["error"].retry_after_ms >= 100
+    assert queue.depths() == {"interactive": 1, "bulk": 1}
+    assert queue.shed_counts == {"interactive": 0, "bulk": 1}
+    assert metrics.value("serve.shed.overload") == 1
+    # interactive was untouched and still dequeues first
+    queue.release()
+    _wait_for(lambda: "waited_ms" in inter)
+    queue.release()
+    _wait_for(lambda: "waited_ms" in new_bulk)
+    queue.release()
+    for thread in (inter_thread, old_bulk_thread, new_bulk_thread):
+        thread.join(timeout=5)
+
+
+def test_bulk_newcomer_sheds_itself_when_only_interactive_queued():
+    queue = AdmissionQueue(1, capacity=1, retry_after_ms=100)
+    queue.acquire()
+    inter_thread, inter = _acquire_in_thread(queue, "interactive")
+    _wait_for(lambda: queue.depths()["interactive"] == 1)
+    with pytest.raises(Overloaded) as shed:
+        queue.acquire("bulk")
+    assert shed.value.reason == "overload"
+    queue.release()
+    _wait_for(lambda: "waited_ms" in inter)
+    queue.release()
+    inter_thread.join(timeout=5)
+
+
+# -- deadline triage and retry hints -------------------------------------------------
+
+
+def test_deadline_triage_needs_p95_evidence():
+    queue = AdmissionQueue(1, capacity=4)
+    # no completed requests yet → no p95 → triage cannot refuse
+    assert queue.acquire("interactive", deadline_ms=1) >= 0.0
+    queue.release()
+
+
+def test_deadline_triage_rejects_unmeetable_deadlines():
+    for _ in range(20):
+        metrics.observe(SERVICE_HISTOGRAM, 500.0)  # p95 ≈ 500ms
+    queue = AdmissionQueue(1, capacity=4)
+    with pytest.raises(Overloaded) as refused:
+        queue.acquire("interactive", deadline_ms=100)
+    assert refused.value.reason == "deadline"
+    assert queue.deadline_rejections == 1
+    assert metrics.value("serve.shed.deadline") == 1
+    # a meetable deadline is admitted
+    assert queue.acquire("interactive", deadline_ms=10_000) >= 0.0
+    queue.release()
+
+
+def test_retry_hint_scales_with_depth():
+    for _ in range(20):
+        metrics.observe(SERVICE_HISTOGRAM, 1000.0)
+    queue = AdmissionQueue(1, capacity=2, retry_after_ms=100)
+    shallow = queue._retry_hint_ms(1000.0)
+    queue.acquire()
+    threads = []
+    for _ in range(2):
+        thread, _outcome = _acquire_in_thread(queue, "bulk")
+        threads.append(thread)
+    _wait_for(lambda: queue.depths()["bulk"] == 2)
+    deep = queue._retry_hint_ms(1000.0)
+    assert deep > shallow >= 100
+    queue.shed_class("bulk")
+    queue.release()
+    for thread in threads:
+        thread.join(timeout=5)
+
+
+# -- drain ---------------------------------------------------------------------------
+
+
+def test_close_refuses_with_shutting_down():
+    queue = AdmissionQueue(1, capacity=4)
+    queue.close()
+    with pytest.raises(Overloaded) as refused:
+        queue.acquire()
+    assert refused.value.reason == "shutting_down"
+
+
+def test_shed_class_wakes_bulk_keeps_interactive():
+    queue = AdmissionQueue(1, capacity=4, retry_after_ms=100)
+    queue.acquire()
+    inter_thread, inter = _acquire_in_thread(queue, "interactive")
+    bulk_thread, bulk = _acquire_in_thread(queue, "bulk")
+    _wait_for(lambda: sum(queue.depths().values()) == 2)
+    assert queue.shed_class("bulk") == 1
+    _wait_for(lambda: "error" in bulk)
+    assert bulk["error"].reason == "shutting_down"
+    assert metrics.value("serve.drain.shed") == 1
+    # queued interactive still completes (the drain promise)
+    queue.release()
+    _wait_for(lambda: "waited_ms" in inter)
+    queue.release()
+    inter_thread.join(timeout=5)
+    bulk_thread.join(timeout=5)
+
+
+def test_wait_idle_reports_drain_completion():
+    queue = AdmissionQueue(1, capacity=4)
+    queue.acquire()
+    assert not queue.wait_idle(0.05)  # still one grant out
+
+    def release_soon():
+        time.sleep(0.05)
+        queue.release()
+
+    threading.Thread(target=release_soon, daemon=True).start()
+    assert queue.wait_idle(5.0)
+
+
+def test_status_rollup():
+    queue = AdmissionQueue(2, capacity=8)
+    queue.acquire()
+    status = queue.status()
+    assert status["slots"] == 2 and status["capacity"] == 8
+    assert status["active"] == 1 and not status["closed"]
+    assert status["depth"] == {"interactive": 0, "bulk": 0}
+    queue.release()
+
+
+# -- autoscaler (tick-driven, fakes) -------------------------------------------------
+
+
+class _FakeSupervisor:
+    def __init__(self, workers=1):
+        self.workers = workers
+        self.busy = 0
+        self.scaled_to = []
+
+    def occupancy(self):
+        return {"busy": self.busy, "live": self.workers}
+
+    def scale_to(self, target):
+        self.scaled_to.append(target)
+        self.workers = target
+        return target
+
+
+class _FakeAdmission:
+    def __init__(self):
+        self.depth = {"interactive": 0, "bulk": 0}
+
+    def depths(self):
+        return dict(self.depth)
+
+
+def _autoscaler(supervisor, admission, **overrides):
+    defaults = dict(minimum=1, maximum=3, interval_ms=50,
+                    up_after=2, down_after=3)
+    defaults.update(overrides)
+    return Autoscaler(supervisor, admission, **defaults)
+
+
+def test_autoscaler_disabled_without_max():
+    supervisor = _FakeSupervisor()
+    scaler = Autoscaler(supervisor, _FakeAdmission(), minimum=1, maximum=0)
+    assert not scaler.enabled
+    scaler.start()  # no-op: no thread, no scaling
+    assert scaler._thread is None
+
+
+def test_scale_up_after_consecutive_backlogged_ticks():
+    supervisor = _FakeSupervisor(workers=1)
+    admission = _FakeAdmission()
+    scaler = _autoscaler(supervisor, admission)
+    assert scaler.enabled and scaler.target == 1
+    admission.depth["bulk"] = 2
+    supervisor.busy = 1  # every live worker busy + queue nonempty
+    scaler.tick()  # 1 backlogged tick: hysteresis holds
+    assert scaler.target == 1
+    scaler.tick()  # 2nd consecutive: scale up
+    assert scaler.target == 2 and scaler.scale_ups == 1
+    assert supervisor.scaled_to[-1] == 2
+    assert metrics.value("serve.autoscale.scale_ups") == 1
+    assert scaler.last_event["dir"] == "up"
+
+
+def test_scale_up_respects_maximum():
+    supervisor = _FakeSupervisor(workers=1)
+    admission = _FakeAdmission()
+    scaler = _autoscaler(supervisor, admission, maximum=2, up_after=1)
+    admission.depth["interactive"] = 5
+    supervisor.busy = supervisor.workers
+    for _ in range(6):
+        scaler.tick()
+        supervisor.busy = supervisor.workers  # stays saturated
+    assert scaler.target == 2  # clamped at maximum
+
+
+def test_scale_down_is_reluctant_and_bounded():
+    supervisor = _FakeSupervisor(workers=3)
+    admission = _FakeAdmission()
+    scaler = _autoscaler(supervisor, admission, down_after=3)
+    scaler.target = 3
+    for _ in range(2):
+        scaler.tick()  # idle, but below down_after
+    assert scaler.target == 3
+    scaler.tick()  # 3rd consecutive idle: scale down by one
+    assert scaler.target == 2 and scaler.scale_downs == 1
+    assert metrics.value("serve.autoscale.scale_downs") == 1
+    for _ in range(20):
+        scaler.tick()
+    assert scaler.target == 1  # never below minimum
+
+
+def test_mixed_state_resets_hysteresis():
+    supervisor = _FakeSupervisor(workers=1)
+    admission = _FakeAdmission()
+    scaler = _autoscaler(supervisor, admission, up_after=2)
+    admission.depth["bulk"] = 1
+    supervisor.busy = 1
+    scaler.tick()  # backlogged ×1
+    supervisor.busy = 0
+    admission.depth["bulk"] = 0
+    supervisor.busy = 1  # busy but no queue: neither backlogged nor idle
+    scaler.tick()
+    admission.depth["bulk"] = 1
+    scaler.tick()  # backlogged ×1 again (counter was reset)
+    assert scaler.target == 1 and scaler.scale_ups == 0
+
+
+def test_target_reasserted_every_tick():
+    supervisor = _FakeSupervisor(workers=2)
+    scaler = _autoscaler(supervisor, _FakeAdmission())
+    scaler.target = 2
+    scaler.tick()
+    scaler.tick()
+    # even with no decision, scale_to(target) runs each tick so a pool
+    # that could not shrink (busy workers) converges later
+    assert supervisor.scaled_to == [2, 2]

@@ -361,8 +361,12 @@ def test_inject_device_oom_analysis_completes_via_host_ladder(monkeypatch):
     resilience.configure("device_oom:1")
     injected = _analyze(2, modules)
     assert sorted(i.swc_id for i in injected) == ["106"]
-    assert injected[0].transaction_sequence["steps"][-1]["input"] == \
-        baseline[0].transaction_sequence["steps"][-1]["input"]
+    # both witnesses must target the same function: compare the 4-byte
+    # selector, not the full calldata — the trailing argument bytes are
+    # free in the model (any padding satisfies the query), so their
+    # exact concretisation is CDCL-choice-dependent, not semantic
+    assert injected[0].transaction_sequence["steps"][-1]["input"][:10] == \
+        baseline[0].transaction_sequence["steps"][-1]["input"][:10]
 
     stats = SolverStatistics()
     assert stats.failure_counts == {"device:device_oom": 1}

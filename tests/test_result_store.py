@@ -1,0 +1,236 @@
+"""Content-addressed result store tests: keying, persistence,
+two-daemon union-merge, corrupt-sidecar tolerance, eviction, and the
+quarantine interaction. Stdlib-only — no engine, no jax."""
+
+import json
+import threading
+
+import pytest
+
+from mythril_tpu.observe import metrics
+from mythril_tpu.serve.quarantine import QuarantineStore, contract_key
+from mythril_tpu.serve.result_store import (
+    RESULTS_VERSION, ResultStore, load_results, result_key,
+    results_path_for, save_results)
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _payload(issues=0):
+    return {"issue_count": issues, "incomplete": False, "coverage": {},
+            "report": {"issues": []}}
+
+
+# -- keying --------------------------------------------------------------------------
+
+
+def test_result_key_is_config_sensitive():
+    base = {"code": "6001"}
+    key = result_key(base)
+    # identical request → identical key (content addressing)
+    assert result_key({"code": "6001"}) == key
+    # bytecode normalization: 0x prefix and case do not split the key
+    assert result_key({"code": "0x6001"}) == key
+    # every config axis must miss — a config change may change the
+    # verdict, so it must never serve the old one
+    assert result_key({"code": "6001", "transaction_count": 3}) != key
+    assert result_key({"code": "6001", "max_depth": 9}) != key
+    assert result_key({"code": "6001", "strategy": "dfs"}) != key
+    assert result_key({"code": "6001", "solver": "brute"}) != key
+    assert result_key({"code": "6001", "engine": "tpu"}) != key
+    assert result_key({"code": "6001", "bin_runtime": True}) != key
+    assert result_key({"code": "6001", "modules": ["Suicide"]}) != key
+
+
+def test_result_key_applies_daemon_defaults():
+    # an explicit "solver": "cdcl" and an omitted solver under a cdcl
+    # daemon are the same effective config → the same key
+    assert result_key({"code": "60", "solver": "cdcl"}, solver="cdcl") == \
+        result_key({"code": "60"}, solver="cdcl")
+    assert result_key({"code": "60"}, solver="cdcl") != \
+        result_key({"code": "60"}, solver="brute")
+
+
+def test_result_key_ignores_scheduling_fields():
+    # deadline/priority shape scheduling, not the analysis result
+    assert result_key({"code": "60", "deadline_ms": 50,
+                       "priority": "bulk"}) == result_key({"code": "60"})
+
+
+def test_results_path_sits_beside_manifest():
+    assert results_path_for("/tmp/x/warmset.json") == \
+        "/tmp/x/warmset.results.json"
+
+
+# -- store basics --------------------------------------------------------------------
+
+
+def test_put_get_roundtrip_and_persistence(tmp_path):
+    sidecar = str(tmp_path / "warmset.results.json")
+    store = ResultStore(path=sidecar)
+    key = result_key({"code": "6001"})
+    assert store.get(key) is None  # miss on cold store
+    assert store.put(key, _payload(issues=2))
+    hit = store.get(key)
+    assert hit["issue_count"] == 2
+    # a mutation of the returned payload must not poison the store
+    hit["issue_count"] = 99
+    assert store.get(key)["issue_count"] == 2
+    # a second daemon loading the same sidecar sees the entry
+    reborn = ResultStore(path=sidecar)
+    assert reborn.get(key)["issue_count"] == 2
+    assert metrics.value("cache.result.stored") == 1
+    assert metrics.value("cache.result.hits") == 3
+    assert metrics.value("cache.result.misses") == 1
+
+
+def test_put_refuses_incomplete_payloads(tmp_path):
+    store = ResultStore(path=str(tmp_path / "r.results.json"))
+    key = result_key({"code": "60"})
+    partial = _payload()
+    partial["incomplete"] = True
+    assert not store.put(key, partial)
+    assert store.get(key) is None
+
+
+def test_put_strips_cached_marker(tmp_path):
+    store = ResultStore(path=str(tmp_path / "r.results.json"))
+    key = result_key({"code": "60"})
+    marked = _payload()
+    marked["cached"] = True  # a replayed cached reply must not nest
+    assert store.put(key, marked)
+    assert "cached" not in store.get(key)
+
+
+def test_config_mismatch_misses(tmp_path):
+    store = ResultStore(path=str(tmp_path / "r.results.json"))
+    assert store.put(result_key({"code": "6001"}), _payload())
+    # same bytecode, different analysis config → different key → miss
+    assert store.get(result_key({"code": "6001",
+                                 "transaction_count": 4})) is None
+    assert store.status()["hit_rate"] == 0.0
+
+
+# -- two-daemon union-merge ----------------------------------------------------------
+
+
+def test_concurrent_daemons_union_merge(tmp_path):
+    sidecar = str(tmp_path / "shared.results.json")
+    a = ResultStore(path=sidecar)
+    b = ResultStore(path=sidecar)
+    key_a = result_key({"code": "6001"})
+    key_b = result_key({"code": "6002"})
+    assert a.put(key_a, _payload(issues=1))
+    assert b.put(key_b, _payload(issues=2))
+    # both writes survive on disk: union, not clobber
+    merged = load_results(sidecar)
+    assert set(merged) == {key_a, key_b}
+    reborn = ResultStore(path=sidecar)
+    assert reborn.get(key_a)["issue_count"] == 1
+    assert reborn.get(key_b)["issue_count"] == 2
+
+
+def test_union_merge_under_thread_contention(tmp_path):
+    sidecar = str(tmp_path / "contended.results.json")
+    stores = [ResultStore(path=sidecar) for _ in range(4)]
+    keys = [result_key({"code": f"60{i:02x}"}) for i in range(12)]
+
+    def hammer(store, offset):
+        for i, key in enumerate(keys):
+            store.put(key, _payload(issues=offset * 100 + i))
+
+    threads = [threading.Thread(target=hammer, args=(store, n))
+               for n, store in enumerate(stores)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    assert set(load_results(sidecar)) == set(keys)  # nothing lost
+
+
+def test_collision_resolves_by_higher_seq(tmp_path):
+    sidecar = str(tmp_path / "c.results.json")
+    key = result_key({"code": "60"})
+    save_results(sidecar, {key: {"seq": 5, "payload": _payload(issues=5)}})
+    # a lower-seq write for the same key loses…
+    save_results(sidecar, {key: {"seq": 3, "payload": _payload(issues=3)}})
+    assert load_results(sidecar)[key]["payload"]["issue_count"] == 5
+    # …a higher-seq write wins
+    save_results(sidecar, {key: {"seq": 9, "payload": _payload(issues=9)}})
+    assert load_results(sidecar)[key]["payload"]["issue_count"] == 9
+
+
+# -- corrupt-sidecar tolerance -------------------------------------------------------
+
+
+def test_corrupt_sidecar_degrades_to_cold_store(tmp_path):
+    sidecar = tmp_path / "bad.results.json"
+    sidecar.write_text("{ not json", encoding="utf-8")
+    assert load_results(str(sidecar)) == {}
+    store = ResultStore(path=str(sidecar))  # must not raise
+    key = result_key({"code": "60"})
+    assert store.get(key) is None
+    assert store.put(key, _payload())  # and recovers by rewriting
+    assert load_results(str(sidecar))[key]["payload"]["issue_count"] == 0
+
+
+def test_unknown_version_and_malformed_entries_skipped(tmp_path):
+    future = tmp_path / "future.results.json"
+    future.write_text(json.dumps({"version": RESULTS_VERSION + 1,
+                                  "results": {"k": {"seq": 1,
+                                                    "payload": {}}}}),
+                      encoding="utf-8")
+    assert load_results(str(future)) == {}
+    mixed = tmp_path / "mixed.results.json"
+    good = result_key({"code": "60"})
+    mixed.write_text(json.dumps({
+        "version": RESULTS_VERSION,
+        "results": {
+            good: {"seq": 2, "payload": _payload(issues=7)},
+            "no-payload": {"seq": 1},
+            "not-a-dict": "nope",
+        }}), encoding="utf-8")
+    loaded = load_results(str(mixed))
+    assert set(loaded) == {good}
+    assert loaded[good]["payload"]["issue_count"] == 7
+
+
+# -- eviction ------------------------------------------------------------------------
+
+
+def test_eviction_beyond_max_drops_oldest(tmp_path):
+    sidecar = str(tmp_path / "cap.results.json")
+    store = ResultStore(path=sidecar, max_entries=3)
+    keys = [result_key({"code": f"60{i:02x}"}) for i in range(5)]
+    for i, key in enumerate(keys):
+        assert store.put(key, _payload(issues=i))
+    # oldest two evicted, newest three retained — in memory and on disk
+    assert store.get(keys[0]) is None and store.get(keys[1]) is None
+    assert all(store.get(k) is not None for k in keys[2:])
+    disk = load_results(sidecar)
+    assert set(disk) == set(keys[2:])
+    assert metrics.value("cache.result.evicted") >= 2
+    assert store.status()["entries"] == 3
+
+
+# -- quarantine interaction ----------------------------------------------------------
+
+
+def test_quarantined_hash_never_cached_never_served(tmp_path):
+    quarantine = QuarantineStore(threshold=1)
+    store = ResultStore(path=str(tmp_path / "q.results.json"),
+                        quarantine=quarantine)
+    chash = contract_key("6001")
+    key = result_key({"code": "6001"})
+    # cached before quarantine: the crash must invalidate the answer
+    assert store.put(key, _payload(), contract_hash=chash)
+    quarantine.record_crash(chash, "worker_segv")
+    assert quarantine.is_quarantined(chash)
+    assert store.get(key, contract_hash=chash) is None
+    # and a poisoned hash can never (re-)enter the cache
+    assert not store.put(key, _payload(), contract_hash=chash)

@@ -10,6 +10,7 @@ import io
 import json
 import socket
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -18,6 +19,7 @@ from mythril_tpu.observe import export, metrics, slog, trace
 from mythril_tpu.parallel import jax_solver
 from mythril_tpu.serve import client as serve_client
 from mythril_tpu.serve import daemon, protocol, warmset
+from mythril_tpu.serve.admission import AdmissionQueue, _Waiter
 from mythril_tpu.serve.service import AnalysisService
 
 
@@ -270,16 +272,50 @@ def test_service_replies_to_protocol_errors():
     assert metrics.value("serve.request_errors") == 1
 
 
-def test_service_busy_when_gate_exhausted():
+def test_service_sheds_bulk_when_queue_full(monkeypatch):
+    """With the single slot busy and the queue at capacity with an
+    interactive waiter, a bulk arrival is shed with a typed
+    ``overloaded`` error carrying a retry hint — while the queued
+    interactive request still completes."""
     service = _service(max_inflight=1)
-    assert service._gate.acquire(blocking=False)  # simulate one in flight
-    try:
-        reply = service.handle(protocol.parse_request(
-            '{"op": "analyze", "code": "60"}'))
-    finally:
-        service._gate.release()
-    assert not reply["ok"] and reply["error"]["code"] == "busy"
+    service._admission = AdmissionQueue(1, capacity=1, retry_after_ms=250)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def slow_analysis(params):
+        entered.set()
+        assert release.wait(30)
+        return _fake_payload(params)
+
+    monkeypatch.setattr(service, "_run_analysis", slow_analysis)
+    replies = {}
+
+    def run(tag, frame):
+        replies[tag] = service.handle(protocol.parse_request(frame))
+
+    slow = threading.Thread(target=run, args=(
+        "slow", '{"op": "analyze", "id": "s1", "code": "60"}'), daemon=True)
+    slow.start()
+    assert entered.wait(10)  # the lone slot is now occupied
+    queued = threading.Thread(target=run, args=(
+        "queued", '{"op": "analyze", "id": "q1", "code": "6001"}'),
+        daemon=True)
+    queued.start()
+    deadline = time.monotonic() + 10
+    while sum(service._admission.depths().values()) < 1:
+        assert time.monotonic() < deadline, "waiter never queued"
+        time.sleep(0.01)
+    reply = service.handle(protocol.parse_request(
+        '{"op": "analyze", "id": "b1", "code": "6002", '
+        '"priority": "bulk"}'))
+    release.set()
+    slow.join(timeout=10)
+    queued.join(timeout=10)
+    assert not reply["ok"] and reply["error"]["code"] == "overloaded"
+    assert reply["error"]["retry_after_ms"] >= 250
     assert metrics.value("serve.busy_rejections") == 1
+    assert metrics.value("serve.shed.overload") == 1
+    assert replies["slow"]["ok"] and replies["queued"]["ok"]
 
 
 def test_service_analysis_failure_is_a_reply_not_a_crash(monkeypatch):
@@ -394,28 +430,37 @@ def test_scrapes_answer_while_engine_lock_is_held(monkeypatch):
     assert "exposition" in results["metrics"]
 
 
-def test_busy_bounce_counts_and_correlates(tmp_path):
-    """A busy rejection still counts as an answered request AND a
+def test_shed_bounce_counts_and_correlates(tmp_path):
+    """An overload shed still counts as an answered request AND a
     rejection, and its reply + structured-log line share one
     correlation id minted at admission."""
-    sink = str(tmp_path / "busy.slog")
+    sink = str(tmp_path / "shed.slog")
     slog.enable(sink)
     service = _service(max_inflight=1)
-    assert service._gate.acquire(blocking=False)  # simulate one in flight
+    queue = AdmissionQueue(1, capacity=1, retry_after_ms=100)
+    service._admission = queue
+    assert queue.try_acquire()  # the lone slot is busy
+    # the queue is already at capacity with an interactive waiter, so
+    # the arriving bulk request is itself the lowest-priority victim
+    queue._seq += 1
+    queue._waiters.append(_Waiter("interactive", None, queue._seq))
     try:
         reply = service.handle(protocol.parse_request(
-            '{"op": "analyze", "id": "b1", "code": "60"}'))
+            '{"op": "analyze", "id": "b1", "code": "60", '
+            '"priority": "bulk"}'))
     finally:
-        service._gate.release()
-    assert not reply["ok"] and reply["error"]["code"] == "busy"
+        queue.release()
+    assert not reply["ok"] and reply["error"]["code"] == "overloaded"
+    assert reply["error"]["retry_after_ms"] >= 100
     cid = reply["correlation_id"]
     assert cid
     assert metrics.value("serve.requests") == 1
     assert metrics.value("serve.busy_rejections") == 1
     records = [json.loads(line) for line in open(sink, encoding="utf-8")]
-    busy = [r for r in records if r["event"] == "serve.busy"]
-    assert len(busy) == 1
-    assert busy[0]["cid"] == cid and busy[0]["request_id"] == "b1"
+    shed = [r for r in records if r["event"] == "serve.shed"]
+    assert len(shed) == 1
+    assert shed[0]["cid"] == cid and shed[0]["request_id"] == "b1"
+    assert shed[0]["priority"] == "bulk" and shed[0]["reason"] == "overload"
 
 
 def test_analyze_reply_and_slog_share_correlation_id(tmp_path,
@@ -643,3 +688,51 @@ def test_e2e_second_contract_needs_no_new_compiles(tmp_path, monkeypatch):
     # the manifest now remembers every bucket this daemon compiled
     assert warmset.load_manifest(str(tmp_path / "warmset.json")) \
         == jax_solver.observed_shape_keys()
+
+
+# ---------------------------------------------------------------------------
+# fleet QoS: batch composition order and interactive preemption targeting
+
+
+def test_fleet_ticket_sort_orders_priority_then_deadline():
+    from mythril_tpu.serve.service import _FleetTicket
+
+    bulk_late = _FleetTicket({"priority": "bulk", "deadline_ms": 9000}, "c1")
+    interactive = _FleetTicket({"priority": "interactive"}, "c2")
+    bulk_soon = _FleetTicket({"priority": "bulk", "deadline_ms": 1000}, "c3")
+    no_priority = _FleetTicket({}, "c4")  # defaults to interactive
+
+    group = [bulk_late, interactive, bulk_soon, no_priority]
+    group.sort(key=_FleetTicket.sort_key)
+    # interactive class first (arrival order breaks the tie), then bulk
+    # by earliest deadline
+    assert group == [interactive, no_priority, bulk_soon, bulk_late]
+
+
+def test_fleet_preempt_targets_only_all_bulk_batches():
+    from mythril_tpu.serve.service import _FleetBatcher, _FleetTicket
+
+    metrics.reset()
+    batcher = _FleetBatcher(service=object())
+    bulk_batch = {
+        "preempt": threading.Event(),
+        "tickets": [_FleetTicket({"priority": "bulk"}, "b1"),
+                    _FleetTicket({"priority": "bulk"}, "b2")],
+    }
+    mixed_batch = {
+        "preempt": threading.Event(),
+        "tickets": [_FleetTicket({"priority": "bulk"}, "m1"),
+                    _FleetTicket({"priority": "interactive"}, "m2")],
+    }
+    batcher._inflight = [bulk_batch, mixed_batch]
+
+    assert batcher.preempt_for_interactive() == 1
+    # only the all-bulk batch was told to drain; the batch already
+    # serving an interactive member keeps the engine
+    assert bulk_batch["preempt"].is_set()
+    assert not mixed_batch["preempt"].is_set()
+    assert metrics.value("serve.fleet.preempted") == 1
+
+    # idempotent: an already-preempted batch is not counted again
+    assert batcher.preempt_for_interactive() == 0
+    assert metrics.value("serve.fleet.preempted") == 1

@@ -20,6 +20,10 @@ Tracked numbers and their comparability keys:
   (backend, chunk);
 * ``fleet_ab.wall_speedup`` / ``fleet_ab.flush_occupancy_ratio``, keyed
   by (backend, contracts) — the fleet-vs-sequential corpus A/B;
+* the ``slo.*`` overload-resilience series from the tools/loadgen.py
+  A/B (``interactive_p99_ratio``, ``interactive_served_frac``,
+  ``cache_hit_rate``), keyed by (backend, rate_hz) — all fractions
+  where bigger means a healthier daemon under the same load;
 * the corpus sweep medians and finding totals per engine, keyed by
   (engine, budget_s).
 
@@ -122,6 +126,16 @@ def extract_points(round_label: str, run: dict) -> List[Point]:
             series = "warm_start.spawn_speedup"
             key = (series, parsed.get("backend"))
             points.append(Point(series, key, round_label, speedup, "x"))
+    slo = parsed.get("slo")
+    if isinstance(slo, dict):
+        for field in ("interactive_p99_ratio", "interactive_served_frac",
+                      "cache_hit_rate"):
+            field_value = _num(slo.get(field))
+            if field_value is not None:
+                series = f"slo.{field}"
+                key = (series, parsed.get("backend"), slo.get("rate_hz"))
+                points.append(Point(series, key, round_label,
+                                    field_value, "frac"))
     corpus = parsed.get("corpus")
     if isinstance(corpus, dict):
         for engine in sorted(corpus):

@@ -64,8 +64,12 @@ class _Phase:
         self.slog_path = os.path.join(self.workdir, "serve.slog")
         self.sidecar_path = os.path.join(self.workdir,
                                          "warmset.quarantine.json")
+        # chaos phases repeat the same bytecode on purpose (to hit the
+        # injected fault on redispatch); the result store would answer
+        # the repeats from cache and the fault would never fire
         env = dict(os.environ, JAX_PLATFORMS="cpu",
-                   MYTHRIL_TPU_SLOG=self.slog_path)
+                   MYTHRIL_TPU_SLOG=self.slog_path,
+                   MYTHRIL_TPU_RESULT_STORE="0")
         env.update(extra_env or {})
         self.daemon = subprocess.Popen(
             [sys.executable, "-m", "mythril_tpu.interfaces.cli", "serve",

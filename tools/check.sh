@@ -63,6 +63,11 @@ timeout -k 10 400 env JAX_PLATFORMS=cpu \
     python -m tools.warm_smoke || exit $?
 
 echo
+echo "== load smoke (open-loop overload: 0 interactive shed + autoscale-up + result-store hit) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m tools.load_smoke || exit $?
+
+echo
 echo "== tier-1 (pytest, not slow, 870s budget) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
