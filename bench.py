@@ -153,6 +153,52 @@ def _fleet_corpus():
     return corpus
 
 
+def _shard_corpus():
+    """Deliberately imbalanced mini-corpus for the shard A/B: member 0
+    is a forky branch chain (2^4 live paths — floods its shard's
+    pending pool) while the rest are straight-line stores. Round-robin
+    member placement parks the forky member and one light member on
+    shard 0, so without stealing shard 1 idles once its members drain —
+    exactly the skew the steal pass exists to flatten."""
+    from mythril_tpu.frontends.asm import (assemble, creation_wrapper,
+                                           dispatcher)
+
+    light = ("PUSH1 0x04\nCALLDATALOAD\nPUSH1 0x24\nCALLDATALOAD\nADD\n"
+             "PUSH1 0x00\nSSTORE\n"
+             "PUSH1 0x01\nPUSH1 0x00\nMSTORE\nPUSH1 0x20\nPUSH1 0x00\nRETURN")
+    corpus = [("forky", creation_wrapper(assemble(dispatcher(
+        {"stress()": _branchy_contract(4)}))).hex())]
+    for pad, tag in enumerate(("a", "b", "c")):
+        src = {f"bump_{tag}()": "JUMPDEST\n" * pad + light}
+        corpus.append((f"light_{tag}",
+                       creation_wrapper(assemble(dispatcher(src))).hex()))
+    return corpus
+
+
+def _jain(loads) -> float:
+    """Jain fairness index over per-shard loads (1.0 = perfectly even;
+    1/n = one shard doing all the work). Empty/zero loads read as 1.0:
+    an idle fleet is trivially fair."""
+    square_sum = sum(load * load for load in loads)
+    if not loads or square_sum <= 0:
+        return 1.0
+    return sum(loads) ** 2 / (len(loads) * square_sum)
+
+
+def _mean_shard_occupancy():
+    """Per-device mean running-lane occupancy over the whole run, from
+    the frontier.shard.occupancy histogram labels — the time-averaged
+    load the shard A/B's fairness comparison is scored on."""
+    from mythril_tpu.observe import metrics
+
+    means = []
+    for label in metrics.labels("frontier.shard.occupancy"):
+        hist = metrics.histogram("frontier.shard.occupancy", label)
+        if hist is not None and hist.count:
+            means.append(hist.total / hist.count)
+    return means
+
+
 def _fleet_run(corpus, fleet: bool, budget: int):
     """One corpus pass through MythrilAnalyzer (fleet or sequential);
     returns (wall_s, {contract: sorted detection digests}, flush stats)."""
@@ -446,6 +492,79 @@ def main():
            flush_occupancy_ratio=fleet_ab["flush_occupancy_ratio"],
            shared_flushes=fleet_flush["shared_flushes"])
 
+    # 3c2. shard A/B (README "Mesh-sharded fleet"): the imbalanced mini
+    #     corpus as a 2-shard fleet, device-resident stealing ON (every
+    #     chunk) vs OFF. Parity is the hard gate; the balance score is
+    #     Jain fairness over time-averaged per-shard occupancy, which
+    #     stealing must not worsen. Wall speedup is asserted TPU-only
+    #     (BASELINE round-8 policy) — on CPU the steal pass's own jit
+    #     dispatch overhead can exceed the rebalance win at this scale.
+    saved_env = {key: os.environ.get(key)
+                 for key in ("MYTHRIL_TPU_MAX_STEPS", "MYTHRIL_TPU_LANES",
+                             "MYTHRIL_TPU_CHECK_ESCAPES",
+                             "MYTHRIL_TPU_BATCH_FLUSH",
+                             "MYTHRIL_TPU_BATCH_AGE_MS",
+                             "MYTHRIL_TPU_DEVICE_CLAUSE_CAP",
+                             "MYTHRIL_TPU_FLEET_SHARD",
+                             "MYTHRIL_TPU_STEAL_CADENCE",
+                             "MYTHRIL_TPU_STEAL_MIN_IMBALANCE")}
+    os.environ["MYTHRIL_TPU_MAX_STEPS"] = "4096"
+    # 16 lanes / 2 shards -> 4 seed lanes per member: the forky member's
+    # 2^4 fork tree overflows its segment into the pending pool, so the
+    # steal pass has real rows to move (64 lanes would absorb the tree).
+    os.environ["MYTHRIL_TPU_LANES"] = "16"
+    os.environ["MYTHRIL_TPU_CHECK_ESCAPES"] = "1"
+    os.environ["MYTHRIL_TPU_BATCH_FLUSH"] = "64"
+    os.environ["MYTHRIL_TPU_BATCH_AGE_MS"] = "60000"
+    if backend == "cpu":
+        os.environ["MYTHRIL_TPU_DEVICE_CLAUSE_CAP"] = "1"
+    os.environ["MYTHRIL_TPU_FLEET_SHARD"] = "2"
+    os.environ["MYTHRIL_TPU_STEAL_MIN_IMBALANCE"] = "1"
+    shard_corpus = _shard_corpus()
+    try:
+        os.environ["MYTHRIL_TPU_STEAL_CADENCE"] = "0"
+        metrics.reset("frontier.shard")
+        with trace.span("bench.shard_nosteal"):
+            nosteal_wall, nosteal_digests, _ = _fleet_run(
+                shard_corpus, fleet=True, budget=fleet_budget)
+        fairness_nosteal = _jain(_mean_shard_occupancy())
+        os.environ["MYTHRIL_TPU_STEAL_CADENCE"] = "1"
+        metrics.reset("frontier.shard")
+        with trace.span("bench.shard_steal"):
+            steal_wall, steal_digests, _ = _fleet_run(
+                shard_corpus, fleet=True, budget=fleet_budget)
+        fairness_steal = _jain(_mean_shard_occupancy())
+        steal_rows_moved = int(metrics.value("frontier.shard.steal_rows"))
+    finally:
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+    shard_ab = {
+        "contracts": len(shard_corpus),
+        "devices": 2,
+        "parity": steal_digests == nosteal_digests,
+        "fairness_nosteal": round(fairness_nosteal, 4),
+        "fairness_steal": round(fairness_steal, 4),
+        "fairness_gain": round(fairness_steal - fairness_nosteal, 4),
+        "steal_rows": steal_rows_moved,
+        "steal": {"wall_s": round(steal_wall, 2)},
+        "nosteal": {"wall_s": round(nosteal_wall, 2)},
+        "wall_speedup": round(nosteal_wall / max(steal_wall, 1e-9), 2),
+    }
+    _phase("shard_ab", devices=shard_ab["devices"],
+           parity=shard_ab["parity"], steal_rows=shard_ab["steal_rows"],
+           fairness_gain=shard_ab["fairness_gain"],
+           wall_speedup=shard_ab["wall_speedup"])
+    assert shard_ab["parity"], (
+        f"shard A/B detection mismatch: steal={steal_digests} "
+        f"nosteal={nosteal_digests}")
+    if backend == "cpu" and shard_ab["steal_rows"] > 0:
+        # CPU acceptance: rebalancing must raise (never lower) fairness
+        assert shard_ab["fairness_gain"] >= -1e-6, (
+            f"stealing lowered Jain fairness: {shard_ab}")
+
     # 3d. durable-warmth A/B (README "Durable warmth"): cold vs warm
     #     worker spawn-to-ready, in child interpreters so the parent's
     #     warm jit caches cannot leak into the "cold" side. Best-effort:
@@ -475,6 +594,7 @@ def main():
             "host": host_info,
             "merge_ab": merge_ab,
             "fleet_ab": fleet_ab,
+        "shard_ab": shard_ab,
             "warm_start": warm_start_ab,
             "frontier": _frontier_rollup(),
         "solver_latency_ms": _solver_latency(),
@@ -508,6 +628,7 @@ def main():
         "sym_host": host_info,
         "merge_ab": merge_ab,
         "fleet_ab": fleet_ab,
+        "shard_ab": shard_ab,
         "warm_start": warm_start_ab,
         "frontier": _frontier_rollup(),
         "solver_latency_ms": _solver_latency(),

@@ -214,6 +214,31 @@ _METRICS: List[MetricSpec] = [
                "others)."),
     MetricSpec("frontier.fleet.phases", COUNTER, "1",
                "Shared device phases run by the fleet driver."),
+    # -- mesh-sharded fleet (parallel/frontier.py shard block + steal pass) ------
+    MetricSpec("frontier.shard.devices", GAUGE, "shards",
+               "Logical shard blocks the fleet frontier is split into "
+               "(lane-axis blocks with per-block scheduler segments)."),
+    MetricSpec("frontier.shard.occupancy", HISTOGRAM, "lanes",
+               "Per-shard running-lane count per chunk (label = dev<i>; "
+               "the balance signal the steal pass acts on)."),
+    MetricSpec("frontier.shard.steals_sent", HISTOGRAM, "rows",
+               "Pending-pool rows donated per shard by the device-"
+               "resident steal pass (label = dev<i>)."),
+    MetricSpec("frontier.shard.steals_received", HISTOGRAM, "rows",
+               "Pending-pool rows adopted per shard from steal passes "
+               "(label = dev<i>)."),
+    MetricSpec("frontier.shard.steal_rows", COUNTER, "rows",
+               "Total pending-pool rows moved between shards by steal "
+               "passes."),
+    MetricSpec("frontier.shard.steal_passes", COUNTER, "1",
+               "Device-resident steal passes dispatched (cadenced; the "
+               "pass itself decides on device whether rows move)."),
+    MetricSpec("frontier.shard.imbalance", GAUGE, "rows",
+               "Last chunk's max-min per-shard load gap (running lanes "
+               "+ pending rows)."),
+    MetricSpec("frontier.shard.fairness", GAUGE, "1",
+               "Jain fairness index of per-shard load, last chunk (1.0 "
+               "= perfectly balanced)."),
     # -- on-device state merging (parallel/symstep.py merge_pass) ----------------
     MetricSpec("frontier.merge.passes", COUNTER, "1",
                "Merge-pass invocations dispatched to the device "

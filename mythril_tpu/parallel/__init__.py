@@ -47,3 +47,7 @@ def _enable_persistent_cache() -> None:
 
 
 _enable_persistent_cache()
+
+# mesh plumbing re-export: the validated logical-shard count used by the
+# frontier (lane-axis blocks), the fleet driver and the serve capacity math
+from .batch import shard_count  # noqa: E402,F401

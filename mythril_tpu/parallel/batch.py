@@ -126,6 +126,32 @@ def next_pow2(value: int, floor: int = 1) -> int:
     return capacity
 
 
+def shard_count(n_lanes: int, requested: int,
+                log: Optional["logging.Logger"] = None) -> int:
+    """Validated logical-shard count for an `n_lanes`-wide frontier.
+
+    `requested` comes from MYTHRIL_TPU_FLEET_SHARD (or a device count):
+    the lane axis is split into that many equal contiguous blocks, so it
+    must divide the lane count and be at least 2 to mean anything. An
+    invalid request falls back to 1 (single-shard) with a logged reason
+    instead of erroring — a mis-sized corpus should run, just unsharded."""
+    if requested <= 1:
+        return 1
+    if n_lanes % requested:
+        if log is not None:
+            log.warning(
+                "fleet shard: %d lanes not divisible by %d shards; "
+                "falling back to single-shard", n_lanes, requested)
+        return 1
+    if n_lanes // requested < 1:
+        if log is not None:
+            log.warning(
+                "fleet shard: %d shards exceed %d lanes; falling back "
+                "to single-shard", requested, n_lanes)
+        return 1
+    return int(requested)
+
+
 def _jumpdest_bitmap(code: bytes, capacity: int) -> np.ndarray:
     """Valid JUMPDEST byte offsets (0x5b outside PUSH immediates)."""
     bitmap = np.zeros(capacity, dtype=bool)

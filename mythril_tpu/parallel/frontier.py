@@ -106,16 +106,23 @@ def _summary(state, planes, arena, sched):
     deadline drain reads lane ownership from it every chunk), then — only
     when the telemetry plane is armed — symstep.telemetry_words(
     sched.telemetry) appended at the END (the counters ride the same
-    single download, zero extra host syncs)."""
+    single download, zero extra host syncs). Sharded schedulers (vector
+    tops) report global sums in slots 0/1 and append a trailing shard
+    block [stack_top[D], esc_count[D], steals_sent[D], steals_received[D],
+    steal_rows] — 4D+1 words the host slices off by its static D."""
     esc_rows = sched.esc_state.status.shape[0]
-    live = jnp.arange(esc_rows) < sched.esc_count
+    sharded = sched.stack_top.ndim == 1
+    ecount_vec = jnp.atleast_1d(sched.esc_count)
+    seg_esc = esc_rows // ecount_vec.shape[0]
+    live = (jnp.arange(esc_rows) % seg_esc) < jnp.repeat(ecount_vec, seg_esc)
 
     def live_max(column):
         return jnp.max(jnp.where(live, column, 0))
 
     batch = state.status.shape[0]
     scalars = jnp.stack([
-        sched.stack_top.astype(jnp.int64), sched.esc_count.astype(jnp.int64),
+        jnp.sum(sched.stack_top).astype(jnp.int64),
+        jnp.sum(sched.esc_count).astype(jnp.int64),
         sched.executed, sched.forks, sched.pushes, sched.pops,
         arena.n.astype(jnp.int64), arena.n_const.astype(jnp.int64),
         live_max(sched.esc_state.msize).astype(jnp.int64),
@@ -131,6 +138,13 @@ def _summary(state, planes, arena, sched):
     if sched.telemetry is not None:
         packed = jnp.concatenate(
             [packed, symstep.telemetry_words(sched.telemetry)])
+    if sharded:
+        packed = jnp.concatenate([
+            packed,
+            sched.stack_top.astype(jnp.int64),
+            sched.esc_count.astype(jnp.int64),
+            sched.steals_sent, sched.steals_received,
+            sched.steal_rows[None]])
     return packed
 
 
@@ -235,6 +249,145 @@ def _reset_esc(sched):
     return sched._replace(esc_count=jnp.zeros_like(sched.esc_count))
 
 
+def _pack_steal_rows(state_like, planes_like, index, mem_b: int, sp_b: int,
+                     st_b: int, conds_w: int):
+    """Wire format for stolen pending-pool rows: exactly the quantized
+    escape-row codec (_pack_rows) plus the two freeze masks the escape
+    path reads from the summary instead — `status` and `fork_cond` — as
+    trailing i32 columns. A stolen row must arrive on the receiving shard
+    runnable-or-frozen exactly as it left the donor."""
+    i32, u8, gas = _pack_rows(state_like, planes_like, index, mem_b=mem_b,
+                              sp_b=sp_b, st_b=st_b, conds_w=conds_w)
+    extras = jnp.concatenate([
+        state_like.status[index].astype(jnp.int32),
+        planes_like.fork_cond[index].astype(jnp.int32)])
+    return jnp.concatenate([i32, extras]), u8, gas
+
+
+def _unpack_steal_rows(i32, u8, gas, bucket: int, mem_b: int, sp_b: int,
+                       st_b: int, conds_w: int):
+    """Device-side inverse of _pack_steal_rows (the host inverse of the
+    shared layout is _drain_unpack): two dicts of full-width field arrays,
+    keyed like StateBatch/SymPlanes fields. Bitcasts mirror _pack_rows'
+    so unpack(pack(rows)) is bit-identical."""
+    from jax import lax
+
+    from . import words
+
+    limbs = words.NLIMBS
+    offset = [0]
+
+    def cut(count, shape=None, as_u32=False):
+        part = i32[offset[0]:offset[0] + count]
+        offset[0] += count
+        if shape is not None:
+            part = part.reshape(shape)
+        if as_u32:
+            part = lax.bitcast_convert_type(part, jnp.uint32)
+        return part
+
+    rows_state = {}
+    rows_planes = {}
+    for field in _DRAIN_I32_FIELDS:
+        target = rows_planes if field in ("cond_count", "ctx_id",
+                                          "last_jump", "branches") \
+            else rows_state
+        target[field] = cut(bucket)
+    rows_state["stack"] = cut(bucket * sp_b * limbs,
+                              (bucket, sp_b, limbs), as_u32=True)
+    rows_state["storage_keys"] = cut(bucket * st_b * limbs,
+                                     (bucket, st_b, limbs), as_u32=True)
+    rows_state["storage_vals"] = cut(bucket * st_b * limbs,
+                                     (bucket, st_b, limbs), as_u32=True)
+    rows_planes["stack_sym"] = cut(bucket * sp_b, (bucket, sp_b))
+    rows_planes["mem_sym"] = cut(bucket * mem_b, (bucket, mem_b))
+    rows_planes["storage_sym"] = cut(bucket * st_b, (bucket, st_b))
+    rows_planes["conds"] = cut(bucket * conds_w, (bucket, conds_w))
+    rows_state["status"] = cut(bucket)
+    rows_planes["fork_cond"] = cut(bucket)
+    rows_state["memory"] = u8[:bucket * mem_b].reshape(bucket, mem_b)
+    rows_state["storage_used"] = u8[
+        bucket * mem_b:bucket * (mem_b + st_b)].reshape(
+            bucket, st_b).astype(bool)
+    rows_planes["storage_dirty"] = u8[
+        bucket * (mem_b + st_b):bucket * (mem_b + 2 * st_b)].reshape(
+            bucket, st_b).astype(bool)
+    rows_state["gas_used"] = gas
+    return rows_state, rows_planes
+
+
+def _steal_pass(state, sched, min_imbalance: int, max_rows: int):
+    """Device-resident work stealing across the D pool segments of a
+    sharded scheduler: rank shards by load (running lanes + pending
+    rows — both already on device, so the rebalance decision never
+    touches the host), pair the poorest with the richest, and move up to
+    `max_rows` pending rows from each donor's stack top to its
+    receiver's. Moved rows round-trip through the packed steal-row wire
+    format (_pack_steal_rows/_unpack_steal_rows — identity by
+    construction, asserted by the codec parity test) composed with a
+    direct gather for the planes the codec does not carry (code,
+    calldata, env words); donor rows above the new top are garbage by
+    the pool convention, so no zeroing is needed."""
+    import jax
+
+    D = sched.stack_top.shape[0]
+    batch = state.status.shape[0]
+    pool_rows = sched.stack_state.status.shape[0]
+    seg_pool = pool_rows // D
+    mem_b = sched.stack_state.memory.shape[1]
+    sp_b = sched.stack_state.stack.shape[1]
+    st_b = sched.stack_state.storage_keys.shape[1]
+    conds_w = sched.stack_planes.conds.shape[1]
+
+    running = (state.status == RUNNING).reshape(D, batch // D).sum(
+        axis=1, dtype=jnp.int32)
+    load = running + sched.stack_top
+    order = jnp.argsort(load)  # ascending: order[0] poorest
+
+    stack_state, stack_planes = sched.stack_state, sched.stack_planes
+    new_top = sched.stack_top
+    sent, recv = sched.steals_sent, sched.steals_received
+    moved = sched.steal_rows
+    r = jnp.arange(max_rows, dtype=jnp.int32)
+    for i in range(D // 2):  # disjoint pairs, statically unrolled
+        poor, rich = order[i], order[D - 1 - i]
+        diff = load[rich] - load[poor]
+        n = jnp.minimum(jnp.minimum(diff // 2, max_rows),
+                        jnp.minimum(new_top[rich],
+                                    seg_pool - new_top[poor]))
+        n = jnp.where(diff >= min_imbalance,
+                      jnp.maximum(n, 0), 0).astype(jnp.int32)
+        valid = r < n
+        src = jnp.clip(rich * seg_pool + new_top[rich] - 1 - r,
+                       0, pool_rows - 1).astype(jnp.int32)
+        dst = jnp.where(valid, poor * seg_pool + new_top[poor] + r,
+                        pool_rows).astype(jnp.int32)
+        rows_state, rows_planes = jax.tree_util.tree_map(
+            lambda leaf: leaf[src], (stack_state, stack_planes))
+        i32, u8, gas = _pack_steal_rows(stack_state, stack_planes, src,
+                                        mem_b=mem_b, sp_b=sp_b, st_b=st_b,
+                                        conds_w=conds_w)
+        unp_state, unp_planes = _unpack_steal_rows(
+            i32, u8, gas, max_rows, mem_b=mem_b, sp_b=sp_b, st_b=st_b,
+            conds_w=conds_w)
+        rows_state = rows_state._replace(**unp_state)
+        rows_planes = rows_planes._replace(**unp_planes)
+        stack_state = StateBatch(*[
+            pool_leaf.at[dst].set(row, mode="drop")
+            for pool_leaf, row in zip(stack_state, rows_state)])
+        stack_planes = symstep.SymPlanes(*[
+            pool_leaf.at[dst].set(row, mode="drop")
+            for pool_leaf, row in zip(stack_planes, rows_planes)])
+        new_top = new_top.at[rich].add(-n).at[poor].add(n)
+        sent = sent.at[rich].add(n.astype(jnp.int64))
+        recv = recv.at[poor].add(n.astype(jnp.int64))
+        moved = moved + n.astype(jnp.int64)
+    return sched._replace(stack_state=stack_state,
+                          stack_planes=stack_planes, stack_top=new_top,
+                          steals_sent=sent, steals_received=recv,
+                          steal_rows=moved)
+
+
 _gather_rows_jit = None
 _scatter_rows_jit = None
 _summary_jit = None
@@ -242,6 +395,7 @@ _pack_rows_jit = None
 _row_maxima_jit = None
 _reset_esc_jit = None
 _merge_jit = None
+_steal_jit = None
 
 #: greedy pairing rounds per merge invocation — each round collapses one
 #: level of a reconverged fork subtree, so 6 rounds fold up to 64 sibling
@@ -313,6 +467,16 @@ def _merge_compiled():
         _merge_jit = jax.jit(symstep.merge_pass,
                              static_argnames=("n_rounds",))
     return _merge_jit
+
+
+def _steal_compiled():
+    global _steal_jit
+    if _steal_jit is None:
+        import jax
+
+        _steal_jit = jax.jit(_steal_pass,
+                             static_argnames=("min_imbalance", "max_rows"))
+    return _steal_jit
 
 
 class LaneContext(A.TxContext):
@@ -485,6 +649,40 @@ class _Frontier:
         self.fleet_names: List[str] = []
         #: last chunk's per-contract occupancy deltas (frontierview feed)
         self._last_fleet_delta: Optional[np.ndarray] = None
+        #: logical shard count D: the lane axis (and both scheduler pools)
+        #: is split into D equal contiguous blocks, each with its own
+        #: stack/escape segment and top, so a multi-device mesh can place
+        #: one block per device with all of that block's planes local.
+        #: MYTHRIL_TPU_FLEET_SHARD: 0 = auto (device count on real
+        #: multi-device backends, else 1), N = force N logical shards
+        #: (valid on a single CPU device — segmentation is physical-
+        #: device-independent). Invalid requests fall back to 1 with a
+        #: logged reason (batch.shard_count).
+        requested = tpu_config.get_int("MYTHRIL_TPU_FLEET_SHARD", 0)
+        if requested == 0:
+            try:
+                import jax
+
+                devices = jax.devices()
+                if len(devices) > 1 and devices[0].platform != "cpu":
+                    requested = len(devices)
+            except Exception:  # allowlisted in tools/check_excepts.py
+                requested = 0
+        from .batch import shard_count
+
+        self.n_shards = shard_count(n_lanes, requested, log=log)
+        #: steal cadence (chunks between device-resident steal passes;
+        #: 0 disables) and the minimum load gap before a shard pair
+        #: actually exchanges rows
+        self.steal_cadence = tpu_config.get_int("MYTHRIL_TPU_STEAL_CADENCE")
+        self.steal_min_imbalance = tpu_config.get_int(
+            "MYTHRIL_TPU_STEAL_MIN_IMBALANCE")
+        #: host copies of the last summary's shard block (per-shard tops,
+        #: steal counters) — feeds the drains and frontier.shard.* metrics
+        self._shard_tops: Optional[np.ndarray] = None
+        self._shard_esc: Optional[np.ndarray] = None
+        self._shard_steals: Optional[np.ndarray] = None  # sent,recv,rows
+        self._steal_passes = 0
 
     def _harena(self, used=None, used_const=None) -> A.HostArena:
         """The persistent incremental host mirror of the arena (term memo
@@ -513,6 +711,9 @@ class _Frontier:
         esc_rows = int(max(2 * self.n_lanes,
                            min(1 << 16, 8 * self.n_lanes,
                                self.esc_bytes // max(row_bytes, 1))))
+        if self.n_shards > 1:  # equal segments: round pools up to D rows
+            stack_rows += (-stack_rows) % self.n_shards
+            esc_rows += (-esc_rows) % self.n_shards
         # the telemetry decode converts pool high-water marks into HBM
         # byte gauges with this factor — pure host arithmetic on numbers
         # the summary download already carries
@@ -530,8 +731,13 @@ class _Frontier:
             self._tel_prev = None  # device counters restart each phase
             self._last_tag_delta = None
             self._last_fleet_delta = None
+        # shard block stash restarts with the device counters
+        self._shard_tops = None
+        self._shard_esc = None
+        self._shard_steals = None
         return symstep.new_scheduler(state, planes, stack_rows, esc_rows,
-                                     telemetry=telemetry)
+                                     telemetry=telemetry,
+                                     n_shards=self.n_shards)
 
     #: telemetry tag-occupancy slots — one B x K compare per fused step,
     #: so the table stays small; overflow is logged, never silent
@@ -667,8 +873,17 @@ class _Frontier:
                           bytes.fromhex(code_hex[2:] if code_hex.startswith("0x")
                                         else code_hex)))
 
+        # lane placement: identity when unsharded; block-affine when the
+        # frontier is sharded (each seed's lanes land in the shard block
+        # that owns its contract, so the block's planes stay device-local)
+        seed_lanes = self._assign_seed_lanes(len(specs))
+        spec_at = {lane: i for i, lane in enumerate(seed_lanes)}
         lane_specs = []
-        for template, entries, _base_sym, code in specs:
+        for lane_i in range(self.n_lanes):
+            if lane_i not in spec_at:
+                lane_specs.append(LaneSpec(code=b"\x00"))  # dead filler
+                continue
+            template, entries, _base_sym, code = specs[spec_at[lane_i]]
             # symbolic-valued slots enter the table with a 0 placeholder so
             # the slot EXISTS — storage_sym below overlays the arena node
             # (otherwise device SLOADs would read concrete 0 for them)
@@ -680,23 +895,22 @@ class _Frontier:
                 gas_limit=int(template.mstate.gas_limit),
                 address=template.environment.address.raw.value,
             ))
-        # pad to capacity with dead lanes
-        while len(lane_specs) < self.n_lanes:
-            lane_specs.append(LaneSpec(code=b"\x00"))
         state = build_batch(lane_specs)
         planes = symstep.SymPlanes.empty(
             self.n_lanes, state.stack.shape[1], state.memory.shape[1],
             state.storage_keys.shape[1], MAX_CONDS)
 
-        status = np.zeros(self.n_lanes, dtype=np.int32)
-        status[len(specs):] = DEAD
+        status = np.full(self.n_lanes, DEAD, dtype=np.int32)
+        if seed_lanes:
+            status[np.asarray(seed_lanes)] = RUNNING
         state = state._replace(status=np.asarray(status))
 
         storage_sym = np.zeros((self.n_lanes,
                                 state.storage_keys.shape[1]), dtype=np.int32)
         storage_base_sym = np.zeros(self.n_lanes, dtype=bool)
         ctx_id = np.full(self.n_lanes, -1, dtype=np.int32)
-        for lane, (template, entries, base_sym, _code) in enumerate(specs):
+        for lane, (template, entries, base_sym, _code) in zip(
+                seed_lanes, specs):
             storage_base_sym[lane] = base_sym
             tx, _ = template.transaction_stack[-1]
             ctx = LaneContext(str(tx.id), template.environment.calldata,
@@ -725,6 +939,31 @@ class _Frontier:
                                  storage_base_sym=np.asarray(storage_base_sym),
                                  ctx_id=np.asarray(ctx_id))
         return state, planes
+
+    def _assign_seed_lanes(self, n_seeds: int) -> List[int]:
+        """Lane index per seed. Unsharded: identity (seed i -> lane i).
+        Sharded: seeds are distributed over the D lane blocks by their
+        fleet owner's device index (`_seed_owner_index`, set by
+        FleetDriver before seed()) or round-robin for standalone runs,
+        filling each block sequentially; a full block overflows into the
+        next with room — placement is an affinity hint, not a cage."""
+        if self.n_shards <= 1:
+            return list(range(n_seeds))
+        per_block = self.n_lanes // self.n_shards
+        owners = getattr(self, "_seed_owner_index", None)
+        cursor = [0] * self.n_shards
+        lanes: List[int] = []
+        for i in range(n_seeds):
+            want = (owners[i] if owners and i < len(owners)
+                    else i) % self.n_shards
+            blk = want
+            for probe in range(self.n_shards):
+                blk = (want + probe) % self.n_shards
+                if cursor[blk] < per_block:
+                    break
+            lanes.append(blk * per_block + cursor[blk])
+            cursor[blk] += 1
+        return lanes
 
     def _alloc_host_term(self, ctx: "LaneContext", value) -> Optional[int]:
         """Park an arbitrary host BitVec as a V_HOST_TERM arena leaf; the
@@ -835,6 +1074,10 @@ class _Frontier:
             return
         sched = self._new_sched(state, planes)
         stack_rows = sched.stack_state.status.shape[0]
+        # steal width: up to one block's worth of lanes per donor/receiver
+        # pair each pass, bounded by the segment size (static jit arg)
+        steal_max_rows = min(max(stack_rows // max(self.n_shards, 1), 1),
+                             max(16, self.n_lanes // max(self.n_shards, 1)))
         # post-dominator merge-point table (staticanalysis/ via the CFA
         # screen): attribution labels for frontier.merge.tag_merges. The
         # telemetry tag-occupancy deltas on these pcs are the trigger;
@@ -884,6 +1127,19 @@ class _Frontier:
                     state, planes, self.arena, sched, chunk)
             metrics.inc("frontier.chunks")
             steps += chunk
+            # cadenced device-resident steal pass: the trigger (per-shard
+            # load from running lanes + pending rows) and the row moves
+            # both happen on device — the rebalance decision never touches
+            # the host (the cadence itself is host-static arithmetic)
+            if self.n_shards > 1 and self.steal_cadence > 0 \
+                    and (steps // chunk) % self.steal_cadence == 0:
+                with trace.span("frontier.steal"):
+                    sched = _steal_compiled()(
+                        state, sched,
+                        min_imbalance=self.steal_min_imbalance,
+                        max_rows=steal_max_rows)
+                self._steal_passes += 1
+                metrics.inc("frontier.shard.steal_passes")
             # PIPELINE: the chunk dispatch above is async — materialize the
             # previously-fetched escape rows NOW, while the device steps
             if backlog is not None:
@@ -897,6 +1153,14 @@ class _Frontier:
             with trace.span("frontier.sync"):
                 packed = np.asarray(jax.device_get(
                     _summary_compiled()(state, planes, self.arena, sched)))
+            # a sharded scheduler appends [tops[D], esc[D], sent[D],
+            # recv[D], moved] — peel it off the tail first (D is static
+            # host knowledge; the block rides the same single download)
+            shard_words = None
+            if self.n_shards > 1:
+                n_shard_words = 4 * self.n_shards + 1
+                shard_words = packed[-n_shard_words:]
+                packed = packed[:-n_shard_words]
             (stack_top, esc_count, executed, forks, pushes, pops, arena_n,
              arena_nc, esc_msize, esc_sp, esc_slots, esc_conds, _batch) = (
                  int(v) for v in packed[:13])
@@ -905,6 +1169,8 @@ class _Frontier:
                                13 + 2 * self.n_lanes].astype(np.int32)
             lane_ctx = packed[13 + 2 * self.n_lanes:
                               13 + 3 * self.n_lanes].astype(np.int32)
+            if shard_words is not None:
+                self._publish_shard(shard_words, status)
             if sched.telemetry is not None:
                 self._publish_telemetry(
                     packed[13 + 3 * self.n_lanes:],
@@ -943,9 +1209,17 @@ class _Frontier:
             # total deadlock with the sibling stack full: spill half the
             # waiting forkers to the host overflow tier
             waiting = (status == FORKING) & (fork_cond != 0)
+            # sharded: a single FULL segment can wedge its block's forkers
+            # even while other segments have room (pushes are segment-
+            # local), so the spill trigger is the fullest segment
+            if self.n_shards > 1 and self._shard_tops is not None:
+                stack_full = int(np.max(self._shard_tops)) \
+                    >= stack_rows // self.n_shards
+            else:
+                stack_full = stack_top >= stack_rows
             if waiting.any() and not (status == RUNNING).any() \
                     and not (status == DEAD).any() \
-                    and stack_top >= stack_rows:
+                    and stack_full:
                 lanes = np.nonzero(waiting)[0]
                 self._spill_host(state, planes, status,
                                  [int(l) for l in lanes[:max(1, len(lanes)
@@ -1153,6 +1427,57 @@ class _Frontier:
                     name: int(count)
                     for name, count in zip(self.fleet_names, fleet_d)})
 
+    def _publish_shard(self, shard_words, status) -> None:
+        """Decode the summary's trailing shard block — pure host numpy on
+        the single download the chunk already paid for. Publishes the
+        frontier.shard.* metrics (per-shard occupancy, steal counters as
+        per-chunk deltas, imbalance + Jain fairness over per-shard load)
+        and a frontierview counter track, and stashes the per-shard tops
+        and escape counts the segmented host drains read."""
+        words = np.asarray(shard_words, dtype=np.int64)
+        n = self.n_shards
+        tops = words[:n]
+        esc = words[n:2 * n]
+        sent = words[2 * n:3 * n]
+        recv = words[3 * n:4 * n]
+        moved = int(words[4 * n])
+        self._shard_tops = tops
+        self._shard_esc = esc
+        prev = self._shard_steals
+        self._shard_steals = (sent, recv, moved)
+        occ = (np.asarray(status) == RUNNING).reshape(n, -1).sum(axis=1)
+        # load = running lanes + pending pool rows, the steal pass's own
+        # ranking signal; Jain fairness of it is the balance criterion
+        load = occ.astype(np.float64) + tops.astype(np.float64)
+        square_sum = float(np.sum(load * load))
+        fairness = (float(np.sum(load)) ** 2 / (n * square_sum)
+                    if square_sum > 0 else 1.0)
+        metrics.set_gauge("frontier.shard.devices", n)
+        metrics.set_gauge("frontier.shard.imbalance",
+                          int(load.max() - load.min()))
+        metrics.set_gauge("frontier.shard.fairness", round(fairness, 4))
+        for dev in range(n):
+            metrics.observe("frontier.shard.occupancy", int(occ[dev]),
+                            label=f"dev{dev}")
+        # steal counters accumulate on device within a phase: delta here
+        if prev is not None:
+            d_sent, d_recv = sent - prev[0], recv - prev[1]
+            d_moved = moved - prev[2]
+        else:
+            d_sent, d_recv, d_moved = sent, recv, moved
+        for dev in range(n):
+            if int(d_sent[dev]):
+                metrics.observe("frontier.shard.steals_sent",
+                                int(d_sent[dev]), label=f"dev{dev}")
+            if int(d_recv[dev]):
+                metrics.observe("frontier.shard.steals_received",
+                                int(d_recv[dev]), label=f"dev{dev}")
+        if d_moved:
+            metrics.inc("frontier.shard.steal_rows", int(d_moved))
+        if trace.enabled():
+            trace.counter("frontier.shard", **{
+                f"dev{dev}": int(load[dev]) for dev in range(n)})
+
     @staticmethod
     def _discard_checkpoint(checkpoint_path) -> None:
         """The device phase ended and its wave is fully on the host side:
@@ -1186,21 +1511,39 @@ class _Frontier:
         Gating: MYTHRIL_TPU_SHARD=1 forces on, =0 forces off; default is
         on only for REAL accelerator meshes (the CI conftest creates 8
         virtual CPU devices for mesh tests, and paying the GSPMD compile
-        of the fused step on every CPU test run is not acceptable)."""
-        import os
+        of the fused step on every CPU test run is not acceptable).
 
+        Mesh-aware plane placement: with a logically sharded frontier
+        (n_shards contiguous lane blocks, each block's contract planes
+        seeded block-local) the mesh size must put device boundaries ON
+        block boundaries — otherwise one block straddles two devices and
+        lockstep stepping gathers its planes cross-device every step. Any
+        misfit (lane count not divisible, shard/device counts unaligned)
+        falls back to single-device with a logged reason, never an
+        error."""
         import jax
 
         devices = jax.devices()
         flag = tpu_config.get_raw("MYTHRIL_TPU_SHARD")
-        if flag == "1" and len(devices) > 1 and self.n_lanes % len(devices):
-            log.warning(
-                "MYTHRIL_TPU_SHARD=1 but %d lanes do not divide across %d "
-                "devices; running single-device (set MYTHRIL_TPU_LANES to a "
-                "multiple of the device count)", self.n_lanes, len(devices))
-        if flag == "0" or len(devices) < 2 or self.n_lanes % len(devices):
+        n_dev = len(devices)
+        if flag == "0" or n_dev < 2:
             return None
         if flag != "1" and devices[0].platform == "cpu":
+            return None
+        if self.n_lanes % n_dev:
+            log.warning(
+                "%d lanes do not divide across %d devices; running "
+                "single-device (set MYTHRIL_TPU_LANES to a multiple of "
+                "the device count)", self.n_lanes, n_dev)
+            return None
+        if self.n_shards > 1 and self.n_shards % n_dev \
+                and n_dev % self.n_shards:
+            log.warning(
+                "mesh of %d devices does not align with %d logical shard "
+                "blocks (device boundaries must land on block "
+                "boundaries); running single-device — set "
+                "MYTHRIL_TPU_FLEET_SHARD to a multiple or divisor of the "
+                "device count", n_dev, self.n_shards)
             return None
         from jax.sharding import (Mesh, NamedSharding, PartitionSpec)
 
@@ -1302,13 +1645,30 @@ class _Frontier:
         if count:
             self.deferred.append([rows_state, rows_planes, count, 0])
 
+    @staticmethod
+    def _pool_used_indices(counts, pool_rows: int) -> np.ndarray:
+        """Host-side row index of a pool's used rows. Scalar count: the
+        plain prefix [0, count). Sharded (i64[D] per-segment counts): the
+        concatenation of each segment's prefix [d*seg, d*seg+counts[d])
+        — used rows are segment-local prefixes, not one global prefix."""
+        counts = np.atleast_1d(np.asarray(counts))
+        seg = pool_rows // len(counts)
+        parts = [np.arange(d * seg, d * seg + int(c), dtype=np.int64)
+                 for d, c in enumerate(counts)]
+        return (np.concatenate(parts) if parts
+                else np.zeros(0, dtype=np.int64))
+
     def _materialize_pool_prefix(self, pool_state, pool_planes,
-                                 used: int) -> None:
-        """Materialize rows [0, used) of a scheduler pool (hand-over)."""
-        if not used:
+                                 used) -> None:
+        """Materialize the used rows of a scheduler pool (hand-over):
+        `used` is a row-index array, or a scalar meaning rows [0, used)."""
+        index = np.asarray(used)
+        if index.ndim == 0:
+            index = np.arange(int(index))
+        if not len(index):
             return
         rows_state, rows_planes, count = self._fetch_rows(
-            pool_state, pool_planes, np.arange(used))
+            pool_state, pool_planes, index)
         if count:
             self.deferred.append([rows_state, rows_planes, count, 0])
 
@@ -1386,13 +1746,21 @@ class _Frontier:
         delta_handle = self.harena.refresh_async(self.arena, arena_n,
                                                  arena_nc)
         esc_cap = sched.esc_state.status.shape[0]
-        bucket = min(next_pow2(max(esc_count, 1)), esc_cap)
+        # sharded: used escape rows are per-segment prefixes — the shard
+        # block parsed from this chunk's summary carries the counts, so no
+        # extra device read is needed
+        if self.n_shards > 1 and self._shard_esc is not None:
+            pool_used = self._pool_used_indices(self._shard_esc, esc_cap)
+        else:
+            pool_used = np.arange(min(esc_count, esc_cap))
+        count = len(pool_used)
+        bucket = min(next_pow2(max(count, 1)), esc_cap)
         index = np.zeros(bucket, dtype=np.int32)
-        index[:min(esc_count, bucket)] = np.arange(min(esc_count, bucket))
+        index[:min(count, bucket)] = pool_used[:bucket]
         pack_handle = self._pack_async(
             sched.esc_state, sched.esc_planes, index, esc_msize, esc_sp,
             esc_slots, esc_conds)
-        return pack_handle, delta_handle, esc_count
+        return pack_handle, delta_handle, count
 
     def _flush_backlog(self, backlog) -> None:
         """Land a drain's transfers in host RAM and queue the rows for
@@ -1438,15 +1806,17 @@ class _Frontier:
         from .batch import next_pow2
 
         rows: List[Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]] = []
-        for pool_state, pool_planes, used in (
-                (sched.stack_state, sched.stack_planes,
-                 int(sched.stack_top)),
-                (sched.esc_state, sched.esc_planes, int(sched.esc_count))):
+        for pool_state, pool_planes, counts in (
+                (sched.stack_state, sched.stack_planes, sched.stack_top),
+                (sched.esc_state, sched.esc_planes, sched.esc_count)):
+            pool_used = self._pool_used_indices(
+                np.asarray(counts), pool_state.status.shape[0])
+            used = len(pool_used)
             if not used:
                 continue
             bucket = min(next_pow2(used), pool_state.status.shape[0])
             index = np.zeros(bucket, dtype=np.int32)
-            index[:used] = np.arange(used)
+            index[:used] = pool_used[:bucket]
             rows_state, rows_planes = jax.device_get(
                 _gather_rows_compiled()(pool_state, pool_planes, index))
             for row in range(used):
@@ -1965,7 +2335,8 @@ class _Frontier:
                           | (status == ESCAPED))[0]
         sched_backlog = 0
         if sched is not None:
-            sched_backlog = int(sched.stack_top) + int(sched.esc_count)
+            sched_backlog = int(np.sum(np.asarray(sched.stack_top))) \
+                + int(np.sum(np.asarray(sched.esc_count)))
         backlog = len(self.pending) + sched_backlog
         if time_handler.time_remaining() <= 1000 and (len(live) or backlog):
             # execution budget exhausted: the host could not explore these
@@ -1995,11 +2366,16 @@ class _Frontier:
         # LIGHT pack path — the full 44-leaf gather paid a ~30 ms tunnel
         # floor per leaf and moved whole 40 KB rows
         if sched is not None:
-            self._materialize_pool_prefix(sched.stack_state,
-                                          sched.stack_planes,
-                                          int(sched.stack_top))
-            self._materialize_pool_prefix(sched.esc_state, sched.esc_planes,
-                                          int(sched.esc_count))
+            self._materialize_pool_prefix(
+                sched.stack_state, sched.stack_planes,
+                self._pool_used_indices(
+                    np.asarray(sched.stack_top),
+                    sched.stack_state.status.shape[0]))
+            self._materialize_pool_prefix(
+                sched.esc_state, sched.esc_planes,
+                self._pool_used_indices(
+                    np.asarray(sched.esc_count),
+                    sched.esc_state.status.shape[0]))
         for row_state, row_planes in self.pending:
             self.deferred.append([
                 {field: value[None] for field, value in row_state.items()},
@@ -2016,8 +2392,10 @@ class _Frontier:
         if sched is not None:
             stack_ids = np.asarray(sched.stack_planes.ctx_id)
             esc_ids = np.asarray(sched.esc_planes.ctx_id)
-            ctx_ids += [int(c) for c in stack_ids[:int(sched.stack_top)]]
-            ctx_ids += [int(c) for c in esc_ids[:int(sched.esc_count)]]
+            ctx_ids += [int(c) for c in stack_ids[self._pool_used_indices(
+                np.asarray(sched.stack_top), len(stack_ids))]]
+            ctx_ids += [int(c) for c in esc_ids[self._pool_used_indices(
+                np.asarray(sched.esc_count), len(esc_ids))]]
         for _, row_planes in self.pending:
             ctx_ids.append(int(np.asarray(row_planes["ctx_id"]).flat[0]))
         for cid in ctx_ids:
@@ -2505,6 +2883,11 @@ class FleetDriver:
         frontier = _Frontier(primary, n_lanes=max(lane_budget,
                                                   2 * len(seeds)))
         frontier.fleet = self
+        # per-shard member affinity: seed() places each member's lanes in
+        # the shard block matching its index, so a block's contract
+        # planes are local to the device that steps it
+        frontier._seed_owner_index = [
+            gated.index(owner) for owner in owners]
         with trace.span("frontier.fleet.seed", seeds=len(seeds),
                         contracts=len(gated)):
             state, planes = frontier.seed(seeds)

@@ -241,40 +241,67 @@ class DeviceScheduler(NamedTuple):
 
     stack_state: StateBatch    # [P] sibling rows
     stack_planes: "SymPlanes"
-    stack_top: jnp.ndarray     # i32 — rows used
+    stack_top: jnp.ndarray     # i32 scalar, or i32[D] per-shard rows used
     esc_state: StateBatch      # [E] escaped rows
     esc_planes: "SymPlanes"
-    esc_count: jnp.ndarray     # i32 — rows used
+    esc_count: jnp.ndarray     # i32 scalar, or i32[D] per-shard rows used
     executed: jnp.ndarray      # i64 — instruction-states stepped
     forks: jnp.ndarray         # i64 — fork events (claims + pushes)
     pushes: jnp.ndarray        # i64 — siblings pushed to the stack
     pops: jnp.ndarray          # i64 — siblings reseeded from the stack
     enabled: jnp.ndarray       # bool — False = legacy freeze/escape semantics
     telemetry: Optional[Telemetry] = None  # None = telemetry compiled out
+    # work-stealing counters (sharded schedulers only; None when n_shards=1):
+    steals_sent: Optional[jnp.ndarray] = None      # i64[D] rows donated
+    steals_received: Optional[jnp.ndarray] = None  # i64[D] rows adopted
+    steal_rows: Optional[jnp.ndarray] = None       # i64 total rows moved
 
 
 def new_scheduler(state: StateBatch, planes: SymPlanes, stack_rows: int,
                   esc_rows: int, disabled: bool = False,
-                  telemetry: Optional[Telemetry] = None) -> DeviceScheduler:
+                  telemetry: Optional[Telemetry] = None,
+                  n_shards: int = 1) -> DeviceScheduler:
     """Allocate scheduler pools shaped like (state, planes) rows. With
     `disabled`, pushes/buffering/reseeds never engage — the legacy
-    freeze-and-escape semantics for callers without a driver."""
+    freeze-and-escape semantics for callers without a driver.
+
+    With `n_shards` > 1 the pools are logically segmented: shard d owns
+    pool rows [d*P/D, (d+1)*P/D) and the tops become i32[D] vectors, so
+    reseeds/pushes/spills stay shard-local and the steal pass can move
+    rows between segments. `stack_rows`/`esc_rows` must divide evenly."""
+    if n_shards > 1:
+        if stack_rows % n_shards or esc_rows % n_shards:
+            raise ValueError(
+                f"pool rows ({stack_rows}, {esc_rows}) must divide "
+                f"n_shards={n_shards}")
+
     def rows(leaf, n):
         return jnp.zeros((n,) + tuple(leaf.shape[1:]), dtype=leaf.dtype)
+
+    def top():
+        if n_shards > 1:
+            return jnp.zeros(n_shards, dtype=I32)
+        return jnp.asarray(0, dtype=I32)
 
     return DeviceScheduler(
         stack_state=StateBatch(*[rows(leaf, stack_rows) for leaf in state]),
         stack_planes=SymPlanes(*[rows(leaf, stack_rows) for leaf in planes]),
-        stack_top=jnp.asarray(0, dtype=I32),
+        stack_top=top(),
         esc_state=StateBatch(*[rows(leaf, esc_rows) for leaf in state]),
         esc_planes=SymPlanes(*[rows(leaf, esc_rows) for leaf in planes]),
-        esc_count=jnp.asarray(0, dtype=I32),
+        esc_count=top(),
         executed=jnp.asarray(0, dtype=jnp.int64),
         forks=jnp.asarray(0, dtype=jnp.int64),
         pushes=jnp.asarray(0, dtype=jnp.int64),
         pops=jnp.asarray(0, dtype=jnp.int64),
         enabled=jnp.asarray(not disabled),
         telemetry=telemetry,
+        steals_sent=(jnp.zeros(n_shards, dtype=jnp.int64)
+                     if n_shards > 1 else None),
+        steals_received=(jnp.zeros(n_shards, dtype=jnp.int64)
+                         if n_shards > 1 else None),
+        steal_rows=(jnp.asarray(0, dtype=jnp.int64)
+                    if n_shards > 1 else None),
     )
 
 
@@ -282,6 +309,24 @@ def _where_rows(mask, rows, leaf):
     """Per-lane row select with mask broadcast over trailing dims."""
     return jnp.where(mask.reshape(mask.shape + (1,) * (leaf.ndim - 1)),
                      rows, leaf)
+
+
+def _seg_rank(mask, n_seg):
+    """Segment-local 0-based rank of True lanes: the lane axis is split
+    into n_seg equal contiguous blocks (one per shard) and ranks restart
+    at each block boundary. n_seg=1 degenerates to the global rank."""
+    return (mask.astype(I32).reshape(n_seg, -1).cumsum(axis=1).reshape(-1)
+            - 1)
+
+
+def _seg_sum(mask, n_seg):
+    """i32[n_seg] count of True lanes per contiguous lane block."""
+    return mask.reshape(n_seg, -1).sum(axis=1, dtype=I32)
+
+
+def _per_lane(vec, batch):
+    """Broadcast an i32[n_seg] per-shard value to per-lane (i32[batch])."""
+    return jnp.repeat(vec, batch // vec.shape[0])
 
 
 def _operand_syms(state: StateBatch, planes: SymPlanes, n: int):
@@ -316,11 +361,21 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
         state.status == ERRORED, I32(DEAD), state.status))
 
     # ---- reseed DEAD lanes from the sibling stack (deepest = top first) -------------
+    # Sharded schedulers (stack_top i32[D]) treat the lane axis as D equal
+    # contiguous blocks, each owning its own pool segment; ranks, sources
+    # and top updates are all segment-local so no cross-shard gathers
+    # appear in the step. D=1 reduces to the exact scalar math.
     pool_rows = sched.stack_state.status.shape[0]
+    sharded = sched.stack_top.ndim == 1
+    top_vec = jnp.atleast_1d(sched.stack_top)
+    n_seg = top_vec.shape[0]
+    seg_pool = pool_rows // n_seg
+    top_l = _per_lane(top_vec, batch)
+    base_l = _per_lane(jnp.arange(n_seg, dtype=I32) * seg_pool, batch)
     dead0 = state.status == DEAD
-    rrank = jnp.cumsum(dead0.astype(I32)) - 1
-    take = dead0 & (rrank < sched.stack_top) & sched.enabled
-    src = jnp.clip(sched.stack_top - 1 - rrank, 0,
+    rrank = _seg_rank(dead0, n_seg)
+    take = dead0 & (rrank < top_l) & sched.enabled
+    src = jnp.clip(base_l + top_l - 1 - rrank, 0,
                    max(pool_rows - 1, 0)).astype(I32)
     state = StateBatch(*[
         _where_rows(take, pool_leaf[src], leaf)
@@ -328,9 +383,11 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
     planes = SymPlanes(*[
         _where_rows(take, pool_leaf[src], leaf)
         for leaf, pool_leaf in zip(planes, sched.stack_planes)])
-    n_taken = jnp.sum(take, dtype=I32)
-    sched = sched._replace(stack_top=sched.stack_top - n_taken,
-                           pops=sched.pops + n_taken.astype(jnp.int64))
+    n_taken = _seg_sum(take, n_seg)
+    new_top = top_vec - n_taken
+    sched = sched._replace(
+        stack_top=new_top if sharded else new_top[0],
+        pops=sched.pops + jnp.sum(n_taken).astype(jnp.int64))
 
     running = state.status == RUNNING
     # instruction-state accounting ON device: reseeded lanes, claimed fork
@@ -601,17 +658,22 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
     # the next summary sends the driver down the direct-materialize
     # fallback.
     esc_rows = sched.esc_state.status.shape[0]
+    ecount_vec = jnp.atleast_1d(sched.esc_count)
+    seg_esc = esc_rows // n_seg
+    ecount_l = _per_lane(ecount_vec, batch)
+    ebase_l = _per_lane(jnp.arange(n_seg, dtype=I32) * seg_esc, batch)
     esc_now = (new_state.status == ESCAPED) & sched.enabled
-    erank = jnp.cumsum(esc_now.astype(I32)) - 1
-    put = esc_now & (erank < (esc_rows - sched.esc_count))
-    eslot = jnp.where(put, sched.esc_count + erank, esc_rows).astype(I32)
+    erank = _seg_rank(esc_now, n_seg)
+    put = esc_now & (erank < (seg_esc - ecount_l))
+    eslot = jnp.where(put, ebase_l + ecount_l + erank, esc_rows).astype(I32)
     esc_state = StateBatch(*[
         pool_leaf.at[eslot].set(leaf, mode="drop")
         for pool_leaf, leaf in zip(sched.esc_state, new_state)])
     esc_planes = SymPlanes(*[
         pool_leaf.at[eslot].set(leaf, mode="drop")
         for pool_leaf, leaf in zip(sched.esc_planes, new_planes)])
-    esc_used = sched.esc_count + jnp.sum(put, dtype=I32)
+    esc_used_vec = ecount_vec + _seg_sum(put, n_seg)
+    esc_used = esc_used_vec if sharded else esc_used_vec[0]
     sched = sched._replace(esc_state=esc_state, esc_planes=esc_planes,
                            esc_count=esc_used)
     new_state = new_state._replace(
@@ -633,25 +695,34 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
     # lanes explore optimistically, exactly like the host engine's jumpi_.
     max_conds = planes.conds.shape[1]
     want = jumpi_fork | frozen_fork  # cond_room baked into both
+    # claims, pushes and spills are all segment-local when sharded: a
+    # sibling lands in its own block's dead lanes / pool segment / escape
+    # segment, preserving per-shard member affinity
+    lane_base_l = _per_lane(jnp.arange(n_seg, dtype=I32) * (batch // n_seg),
+                            batch)
     is_dead = new_state.status == DEAD
-    dead_rank = jnp.cumsum(is_dead.astype(I32)) - 1
+    dead_rank = _seg_rank(is_dead, n_seg)
     dead_map = jnp.zeros(batch, dtype=I32).at[
-        jnp.where(is_dead, dead_rank, batch)].set(
+        jnp.where(is_dead, lane_base_l + dead_rank, batch)].set(
         lane.astype(I32), mode="drop")
-    fork_rank = jnp.cumsum(want.astype(I32)) - 1
-    n_dead = jnp.sum(is_dead.astype(I32))
-    have_target = want & (fork_rank < n_dead)
+    fork_rank = _seg_rank(want, n_seg)
+    n_dead_l = _per_lane(_seg_sum(is_dead, n_seg), batch)
+    have_target = want & (fork_rank < n_dead_l)
     target = jnp.where(have_target,
-                       dead_map[jnp.clip(fork_rank, 0, batch - 1)],
+                       dead_map[jnp.clip(lane_base_l + fork_rank, 0,
+                                         batch - 1)],
                        batch).astype(I32)
     # saturated forkers push their sibling onto the DFS stack
+    top2_vec = jnp.atleast_1d(sched.stack_top)
+    top2_l = _per_lane(top2_vec, batch)
     push_want = want & ~have_target & sched.enabled
-    push_rank = jnp.cumsum(push_want.astype(I32)) - 1
-    push = push_want & (push_rank < (pool_rows - sched.stack_top))
+    push_rank = _seg_rank(push_want, n_seg)
+    push = push_want & (push_rank < (seg_pool - top2_l))
     # stack full: the sibling spills into the escape buffer instead
+    eused_l = _per_lane(esc_used_vec, batch)
     spill_want = push_want & ~push
-    spill_rank = jnp.cumsum(spill_want.astype(I32)) - 1
-    spill = spill_want & (spill_rank < (esc_rows - esc_used))
+    spill_rank = _seg_rank(spill_want, n_seg)
+    spill = spill_want & (spill_rank < (seg_esc - eused_l))
     act = have_target | push | spill
 
     # taken-side destination validity (dest = concrete stack top)
@@ -704,7 +775,7 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
         for field, sib in zip(planes_a, sib_planes)])
 
     # 3b. push: scatter sibling rows onto the scheduler stack
-    dst = jnp.where(push, sched.stack_top + push_rank,
+    dst = jnp.where(push, base_l + top2_l + push_rank,
                     pool_rows).astype(I32)
     stack_state = StateBatch(*[
         pool_leaf.at[dst].set(sib, mode="drop")
@@ -712,24 +783,27 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
     stack_planes = SymPlanes(*[
         pool_leaf.at[dst].set(sib, mode="drop")
         for pool_leaf, sib in zip(sched.stack_planes, sib_planes)])
-    n_push = jnp.sum(push, dtype=I32)
+    n_push = _seg_sum(push, n_seg)
 
     # 3c. spill: scatter sibling rows into the escape buffer (after any
     #     rows buffered by this step's escapes)
-    sdst = jnp.where(spill, esc_used + spill_rank, esc_rows).astype(I32)
+    sdst = jnp.where(spill, ebase_l + eused_l + spill_rank,
+                     esc_rows).astype(I32)
     esc_state = StateBatch(*[
         pool_leaf.at[sdst].set(sib, mode="drop")
         for pool_leaf, sib in zip(sched.esc_state, sib_state)])
     esc_planes = SymPlanes(*[
         pool_leaf.at[sdst].set(sib, mode="drop")
         for pool_leaf, sib in zip(sched.esc_planes, sib_planes)])
-    n_spill = jnp.sum(spill, dtype=I32)
+    n_spill = _seg_sum(spill, n_seg)
+    top3_vec = top2_vec + n_push
+    esc3_vec = esc_used_vec + n_spill
     sched = sched._replace(
         stack_state=stack_state, stack_planes=stack_planes,
-        stack_top=sched.stack_top + n_push,
+        stack_top=top3_vec if sharded else top3_vec[0],
         esc_state=esc_state, esc_planes=esc_planes,
-        esc_count=esc_used + n_spill,
-        pushes=sched.pushes + n_push.astype(jnp.int64),
+        esc_count=esc3_vec if sharded else esc3_vec[0],
+        pushes=sched.pushes + jnp.sum(n_push).astype(jnp.int64),
         forks=sched.forks + jnp.sum(act).astype(jnp.int64))
 
     # 4. forker divergence: take the jump (or die on an invalid dest)
@@ -786,7 +860,7 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
             one, mode="drop")
 
         lc_deltas = jnp.stack([
-            n_taken.astype(jnp.int64),                        # reseeds
+            jnp.sum(n_taken, dtype=jnp.int64),                # reseeds
             n_err_freed,                                      # err_deaths
             jnp.sum(overflow, dtype=jnp.int64),               # overflow_kills
             jnp.sum(act & ~dest_ok, dtype=jnp.int64),         # bad_jump_deaths
@@ -802,9 +876,10 @@ def sym_step(state: StateBatch, planes: SymPlanes, arena: A.Arena,
 
         occupancy = tel.occupancy + jnp.stack(
             [jnp.sum(running, dtype=jnp.int64), one])
+        # vector tops (sharded) report the global rows-in-use high water
         hwm = jnp.maximum(tel.hwm, jnp.stack(
-            [sched.stack_top.astype(jnp.int64),
-             sched.esc_count.astype(jnp.int64)]))
+            [jnp.sum(sched.stack_top).astype(jnp.int64),
+             jnp.sum(sched.esc_count).astype(jnp.int64)]))
         # per merge-tag / loop-header occupancy: running lanes whose fetch
         # pc sits at a tagged address (state.pc is the pre-step pc here)
         if tel.tag_pcs.shape[0]:

@@ -90,6 +90,39 @@ def _frontier_counters() -> Dict[str, int]:
             for name in _FRONTIER_COUNTERS}
 
 
+def _shard_capacity_factor() -> int:
+    """Shard count a fleet frontier would run with right now: the forced
+    MYTHRIL_TPU_FLEET_SHARD when set, else the device count on a real
+    multi-device mesh (the same auto rule parallel/frontier.py applies).
+    The micro-batcher multiplies its per-batch capacity by it — N shard
+    blocks sweep N contracts' lanes concurrently."""
+    forced = tpu_config.get_int("MYTHRIL_TPU_FLEET_SHARD")
+    if forced > 1:
+        return forced
+    if forced == 0:
+        try:
+            import jax
+
+            devices = jax.devices()
+            if len(devices) > 1 and devices[0].platform != "cpu":
+                return len(devices)
+        except Exception:  # no backend yet: solo capacity
+            log.debug("shard capacity probe failed", exc_info=True)
+    return 1
+
+
+def _shard_rollup() -> Dict[str, object]:
+    """Sharded-fleet gauges for /healthz (declared in observe/metrics.py,
+    fed by the frontier's per-chunk shard-block decode)."""
+    return {
+        "devices": int(metrics.value("frontier.shard.devices")),
+        "steal_rows": int(metrics.value("frontier.shard.steal_rows")),
+        "steal_passes": int(metrics.value("frontier.shard.steal_passes")),
+        "imbalance": int(metrics.value("frontier.shard.imbalance")),
+        "fairness": float(metrics.value("frontier.shard.fairness")),
+    }
+
+
 def execution_timeout_s(deadline_ms: Optional[int]) -> float:
     """A request's ``deadline_ms`` as the engine execution timeout in
     seconds, clamped to the ``MYTHRIL_TPU_SERVE_MAX_DEADLINE_MS``
@@ -186,6 +219,10 @@ class _FleetBatcher:
         window_s = max(
             tpu_config.get_float("MYTHRIL_TPU_FLEET_WINDOW_MS"), 0.0) / 1000.0
         max_batch = max(tpu_config.get_int("MYTHRIL_TPU_FLEET_MAX_BATCH"), 1)
+        # a sharded fleet frontier sweeps one lane block per shard, so the
+        # micro-batch capacity scales with the shard count (devices on a
+        # real mesh, MYTHRIL_TPU_FLEET_SHARD when forced)
+        max_batch *= max(_shard_capacity_factor(), 1)
         key = self._key(params)
         ticket = _FleetTicket(params, cid)
         with self._lock:
@@ -652,6 +689,7 @@ class AnalysisService:
                       int(metrics.value("cache.verdict.loaded")),
                   "warmset": self.warmset.status()},
             frontier=_frontier_counters(),
+            shard=_shard_rollup(),
             queue=self._admission.status(),
             autoscaler=(self._autoscaler.status()
                         if self._autoscaler is not None else None),

@@ -129,6 +129,19 @@ _KNOBS: List[Knob] = [
          "compatible `analyze` requests join one fleet step instead of "
          "queueing on the engine lock); `serve --fleet` sets the same "
          "switch."),
+    Knob("MYTHRIL_TPU_FLEET_SHARD", "int", 0,
+         "Logical shard count for the fleet frontier (lane-axis blocks "
+         "with per-block scheduler segments): 0 = auto (device count on "
+         "real multi-device meshes, else 1), N forces N blocks (valid "
+         "on a single device; must divide the lane count or falls back "
+         "to 1 with a logged reason)."),
+    Knob("MYTHRIL_TPU_STEAL_CADENCE", "int", 4,
+         "Chunks between device-resident work-steal passes on a sharded "
+         "frontier (0 disables stealing)."),
+    Knob("MYTHRIL_TPU_STEAL_MIN_IMBALANCE", "int", 8,
+         "Minimum per-shard load gap (running lanes + pending rows) "
+         "before a rich/poor shard pair actually exchanges rows in a "
+         "steal pass."),
     # -- analysis service (mythril_tpu/serve/) ------------------------------------
     Knob("MYTHRIL_TPU_SERVE_SOCKET", "str", None,
          "Unix-socket path for `myth-tpu serve` / `myth-tpu client` "

@@ -1,0 +1,289 @@
+"""Mesh-sharded fleet frontier (parallel/frontier.py + symstep.py):
+
+* steal-row codec parity — the packed steal-row wire format is the
+  quantized escape-row codec (_pack_rows) plus the two freeze-mask
+  columns, and unpack(pack(rows)) is bit-identical on every covered
+  field including `status`, `fork_cond` and the contract ids;
+* steal pass — a forced 2-shard imbalance moves pending rows from the
+  rich segment's stack top to the starved one's, conserves the total,
+  updates the device-resident steal counters, and raises Jain fairness;
+* shard_count fallback — a lane count indivisible by the requested
+  shard count degrades to single-shard with a logged reason, never an
+  error;
+* 2-shard fleet parity — the same corpus through a sharded fleet
+  (MYTHRIL_TPU_FLEET_SHARD=2, stealing every chunk) produces
+  byte-identical per-contract detections vs the unsharded fleet;
+* the sharding null — forcing 2 shards + per-chunk steal passes adds
+  ZERO host syncs (jax.device_get calls) vs the unsharded run on the
+  same contract: trigger and rebalance live entirely on device.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("MYTHRIL_TPU_LANES", "16")
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mythril_tpu.parallel import batch as pbatch
+from mythril_tpu.parallel import frontier, symstep
+from mythril_tpu.smt.solver import sat
+
+#: a multiplicative hash stride keeps neighbouring elements' bit
+#: patterns unrelated, so a transposed/truncated codec cut cannot
+#: accidentally reproduce the input
+_STRIDE = 2654435761
+
+
+def _filled(tree, seed: int):
+    """Every leaf filled with a distinct deterministic bit pattern
+    (full 32-bit range, so sign bits and bitcasts are exercised)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    for k, leaf in enumerate(leaves):
+        size = max(int(np.prod(leaf.shape)), 1)
+        vals = (np.arange(size, dtype=np.int64) * _STRIDE
+                + seed * 97 + k * 1013) % (1 << 32)
+        arr = vals.reshape(leaf.shape)
+        if leaf.dtype == np.bool_:
+            arr = (arr & 1).astype(bool)
+        else:
+            arr = arr.astype(leaf.dtype)
+        out.append(jnp.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _lane_batch(n_lanes: int):
+    """A small real StateBatch/SymPlanes pair (shapes as production
+    builds them) used both as lane batch and as scheduler pool rows."""
+    specs = [pbatch.LaneSpec(b"\x60\x01\x00", gas_limit=2 ** 30)
+             for _ in range(n_lanes)]
+    state = pbatch.build_batch(specs, stack_slots=8, memory_bytes=64,
+                               calldata_bytes=32, retdata_bytes=16,
+                               storage_slots=4, tstore_slots=2)
+    planes = symstep.SymPlanes.empty(n_lanes, 8, 64, 4, max_conds=4)
+    return state, planes
+
+
+def _codec_widths(state, planes):
+    return dict(mem_b=int(state.memory.shape[1]),
+                sp_b=int(state.stack.shape[1]),
+                st_b=int(state.storage_keys.shape[1]),
+                conds_w=int(planes.conds.shape[1]))
+
+
+def test_steal_codec_roundtrip_matches_escape_codec():
+    """unpack(pack(rows)) reproduces every covered field bit-for-bit,
+    and the i32 section is the escape-row codec's output verbatim with
+    only [status, fork_cond] appended — one wire format, two readers."""
+    state, planes = _lane_batch(6)
+    state = _filled(state, seed=3)
+    planes = _filled(planes, seed=11)
+    index = jnp.asarray([4, 2, 5], dtype=jnp.int32)
+    widths = _codec_widths(state, planes)
+
+    i32, u8, gas = frontier._pack_steal_rows(state, planes, index, **widths)
+    base_i32, base_u8, base_gas = frontier._pack_rows(
+        state, planes, index, **widths)
+
+    # escape-codec parity: same i32 prefix, same u8/gas sections
+    np.testing.assert_array_equal(np.asarray(i32[:base_i32.shape[0]]),
+                                  np.asarray(base_i32))
+    np.testing.assert_array_equal(np.asarray(u8), np.asarray(base_u8))
+    np.testing.assert_array_equal(np.asarray(gas), np.asarray(base_gas))
+    extras = np.asarray(i32[base_i32.shape[0]:])
+    idx = np.asarray(index)
+    np.testing.assert_array_equal(
+        extras[:3], np.asarray(state.status)[idx].astype(np.int32))
+    np.testing.assert_array_equal(
+        extras[3:], np.asarray(planes.fork_cond)[idx].astype(np.int32))
+
+    # bit-identical round trip, freeze masks and contract ids included
+    rows_state, rows_planes = frontier._unpack_steal_rows(
+        i32, u8, gas, 3, **widths)
+    assert "status" in rows_state and "fork_cond" in rows_planes
+    assert "ctx_id" in rows_planes
+    for name, got in rows_state.items():
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(getattr(state, name))[idx],
+            err_msg=f"steal codec corrupted state.{name}")
+    for name, got in rows_planes.items():
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(getattr(planes, name))[idx],
+            err_msg=f"steal codec corrupted planes.{name}")
+
+
+def test_sharded_scheduler_shapes_and_legacy_default():
+    state, planes = _lane_batch(8)
+    sched = symstep.new_scheduler(state, planes, 8, 8, n_shards=2)
+    assert sched.stack_top.shape == (2,)
+    assert sched.esc_count.shape == (2,)
+    assert sched.steals_sent.shape == (2,)
+    assert sched.steals_received.shape == (2,)
+    assert int(sched.steal_rows) == 0
+    # the default is the legacy scalar scheduler with no steal plane
+    legacy = symstep.new_scheduler(state, planes, 8, 8)
+    assert legacy.stack_top.ndim == 0
+    assert legacy.steals_sent is None and legacy.steal_rows is None
+    # indivisible pools refuse loudly at construction, not mid-kernel
+    with pytest.raises(ValueError):
+        symstep.new_scheduler(state, planes, 9, 8, n_shards=2)
+
+
+def test_shard_count_indivisible_falls_back_single_shard():
+    """Satellite: lane counts that don't divide the device count fall
+    back to one shard with a logged reason instead of erroring."""
+    from mythril_tpu.parallel import shard_count
+
+    assert shard_count(16, 2) == 2
+    assert shard_count(16, 4) == 4
+    assert shard_count(16, 3) == 1  # indivisible: logged fallback
+    assert shard_count(2, 16) == 1  # fewer lanes than shards
+    assert shard_count(16, 0) == 1
+    assert shard_count(16, 1) == 1
+
+
+def _jain(load: np.ndarray) -> float:
+    return float(load.sum()) ** 2 / (len(load) * float((load ** 2).sum())
+                                     or 1.0)
+
+
+def test_steal_pass_rebalances_and_preserves_rows():
+    """Forced imbalance (all 4 pending rows in shard 1's segment): one
+    steal pass halves the gap, conserves the row total, bumps the
+    counters, moves the rows bit-identically, and raises fairness."""
+    state, planes = _lane_batch(8)
+    sched = symstep.new_scheduler(state, planes, 8, 8, n_shards=2)
+    # populate the pending pool with recognizable rows; shard 1 (rows
+    # 4..7 of the 8-row pool, segment size 4) holds all 4 pending rows
+    pool_state = _filled(sched.stack_state, seed=21)
+    pool_planes = _filled(sched.stack_planes, seed=42)
+    sched = sched._replace(stack_state=pool_state, stack_planes=pool_planes,
+                           stack_top=jnp.asarray([0, 4], dtype=jnp.int32))
+
+    before = np.asarray(sched.stack_top)
+    load_before = before + 4  # 4 RUNNING lanes per shard from build_batch
+    out = frontier._steal_compiled()(state, sched, min_imbalance=1,
+                                     max_rows=4)
+
+    after = np.asarray(out.stack_top)
+    assert after.sum() == before.sum() == 4
+    np.testing.assert_array_equal(after, [2, 2])
+    np.testing.assert_array_equal(np.asarray(out.steals_sent), [0, 2])
+    np.testing.assert_array_equal(np.asarray(out.steals_received), [2, 0])
+    assert int(out.steal_rows) == 2
+    assert _jain(after + 4) > _jain(load_before)
+
+    # moved rows land bit-identically: receiver slots 0,1 hold donor's
+    # top-down rows (old global rows 7, 6); donor's surviving rows and
+    # both pools' untouched tails are unchanged
+    for tree, new_tree, kind in ((pool_state, out.stack_state, "state"),
+                                 (pool_planes, out.stack_planes, "planes")):
+        for name, old_leaf in zip(tree._fields, tree):
+            old = np.asarray(old_leaf)
+            new = np.asarray(getattr(new_tree, name))
+            np.testing.assert_array_equal(
+                new[0], old[7], err_msg=f"{kind}.{name} row 0 != donor top")
+            np.testing.assert_array_equal(
+                new[1], old[6], err_msg=f"{kind}.{name} row 1 != donor next")
+            np.testing.assert_array_equal(
+                new[4:6], old[4:6],
+                err_msg=f"{kind}.{name} donor's kept rows changed")
+
+
+def test_steal_pass_below_min_imbalance_is_identity():
+    state, planes = _lane_batch(8)
+    sched = symstep.new_scheduler(state, planes, 8, 8, n_shards=2)
+    sched = sched._replace(stack_top=jnp.asarray([1, 2], dtype=jnp.int32))
+    out = frontier._steal_compiled()(state, sched, min_imbalance=8,
+                                     max_rows=4)
+    np.testing.assert_array_equal(np.asarray(out.stack_top), [1, 2])
+    assert int(out.steal_rows) == 0
+
+
+@pytest.mark.skipif(not sat.have_native(),
+                    reason="native CDCL build required")
+def test_sharded_fleet_parity_two_shards(monkeypatch):
+    """Acceptance: the sharded fleet (2 logical shards over the CPU
+    mesh, steal pass every chunk, steal threshold 1) produces
+    byte-identical per-contract detections vs the unsharded fleet —
+    detections are order-canonicalized per contract, exploration ORDER
+    may legally differ."""
+    from test_fleet import ADDFLOW, BRANCHY, COMBO, _analyze_corpus, \
+        _creation_hex
+
+    from mythril_tpu.observe import metrics
+
+    monkeypatch.setenv("MYTHRIL_TPU_LANES", "16")
+    corpus = [("branchy", _creation_hex(BRANCHY)),
+              ("addflow", _creation_hex(ADDFLOW)),
+              ("combo", _creation_hex(COMBO))]
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_SHARD", "0")
+    baseline = _analyze_corpus(corpus, fleet=True)
+    assert any(baseline.values()), \
+        f"unsharded fleet found no issues: {baseline}"
+
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_SHARD", "2")
+    monkeypatch.setenv("MYTHRIL_TPU_STEAL_CADENCE", "1")
+    monkeypatch.setenv("MYTHRIL_TPU_STEAL_MIN_IMBALANCE", "1")
+    passes_before = metrics.value("frontier.shard.steal_passes")
+    sharded = _analyze_corpus(corpus, fleet=True)
+    assert sharded == baseline
+    # the cadenced steal pass actually ran on the sharded side
+    assert metrics.value("frontier.shard.steal_passes") > passes_before
+
+
+@pytest.mark.skipif(not sat.have_native(),
+                    reason="native CDCL build required")
+def test_sharding_adds_no_host_syncs(monkeypatch):
+    """Acceptance (R3): the steal trigger and the rebalance are device
+    resident — forcing 2 shards with a steal pass EVERY chunk changes
+    neither the jax.device_get count nor the detections vs unsharded."""
+    from test_fleet import BRANCHY, _creation_hex, _fresh_engine
+
+    from mythril_tpu.analysis.security import fire_lasers
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+
+    creation = _creation_hex(BRANCHY)
+
+    def count_syncs(shard: bool):
+        monkeypatch.setenv("MYTHRIL_TPU_FLEET_SHARD",
+                           "2" if shard else "0")
+        monkeypatch.setenv("MYTHRIL_TPU_STEAL_CADENCE", "1")
+        monkeypatch.setenv("MYTHRIL_TPU_STEAL_MIN_IMBALANCE", "1")
+        syncs = [0]
+        real_device_get = jax.device_get
+
+        def counting_device_get(x):
+            syncs[0] += 1
+            return real_device_get(x)
+
+        monkeypatch.setattr(jax, "device_get", counting_device_get)
+        try:
+            _fresh_engine()
+            sym = SymExecWrapper(
+                creation, address=None, strategy="bfs", max_depth=128,
+                execution_timeout=240, create_timeout=30,
+                transaction_count=1, compulsory_statespace=False,
+                modules=["AccidentallyKillable"], engine="tpu")
+            issues = fire_lasers(sym, white_list=["AccidentallyKillable"])
+        finally:
+            monkeypatch.setattr(jax, "device_get", real_device_get)
+        detections = sorted((issue.swc_id, issue.address, issue.function)
+                            for issue in issues)
+        return syncs[0], detections
+
+    syncs_off, detections_off = count_syncs(False)
+    syncs_on, detections_on = count_syncs(True)
+    assert detections_on == detections_off
+    assert [d[0] for d in detections_on] == ["106"]
+    assert syncs_on == syncs_off, (
+        f"sharding changed the host-sync count: {syncs_on} sharded vs "
+        f"{syncs_off} unsharded")

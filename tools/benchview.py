@@ -119,6 +119,16 @@ def extract_points(round_label: str, run: dict) -> List[Point]:
                 key = (series, parsed.get("backend"), fleet.get("contracts"))
                 points.append(Point(series, key, round_label,
                                     field_value, "x"))
+    shard = parsed.get("shard_ab")
+    if isinstance(shard, dict):
+        for field in ("wall_speedup", "fairness_gain"):
+            field_value = _num(shard.get(field))
+            if field_value is not None:
+                series = f"shard_ab.{field}"
+                key = (series, parsed.get("backend"), shard.get("devices"),
+                       shard.get("contracts"))
+                points.append(Point(series, key, round_label,
+                                    field_value, "x"))
     warm = parsed.get("warm_start")
     if isinstance(warm, dict):
         speedup = _num(warm.get("spawn_speedup"))

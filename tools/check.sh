@@ -68,6 +68,10 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python -m tools.load_smoke || exit $?
 
 echo
+echo "== multichip r06 (2-device sharded fleet step + steal exchange; skips on singleton) =="
+timeout -k 10 300 python -m tools.shard_smoke || exit $?
+
+echo
 echo "== tier-1 (pytest, not slow, 870s budget) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -56,6 +56,7 @@ LIFECYCLE_TRACK = "frontier.lifecycle"
 TAGS_TRACK = "frontier.tags"
 MERGES_TRACK = "frontier.merges"
 FLEET_TRACK = "frontier.fleet"
+SHARD_TRACK = "frontier.shard"
 
 
 def load_trace(path: str) -> Tuple[List[dict], Dict[str, object]]:
@@ -216,6 +217,32 @@ def _fleet_section(totals: Dict[str, float]) -> List[str]:
     return lines
 
 
+def _shard_section(totals: Dict[str, float]) -> List[str]:
+    """Sharded fleet: per-device (logical shard block) load share bars
+    — running lanes + pending rows, summed over chunks — plus the Jain
+    fairness of those shares (the device-resident steal pass exists to
+    push this toward 1.0)."""
+    lines = ["", "== sharded fleet (per-device load share) =="]
+    if not totals:
+        lines.append("  (no frontier.shard samples — unsharded run; set "
+                     "MYTHRIL_TPU_FLEET_SHARD or run on a multi-device "
+                     "mesh)")
+        return lines
+    shares = [v for v in totals.values() if v > 0]
+    total = sum(shares)
+    peak = max(totals.values()) or 1
+    for name, value in sorted(totals.items()):
+        share = value / total * 100 if total else 0.0
+        lines.append(f"  [{share:5.1f}%] {name:<16} {value:>12.0f}  "
+                     f"|{_bar(value, peak):<{_BAR}}|")
+    if shares:
+        fairness = total * total / (len(shares)
+                                    * sum(v * v for v in shares))
+        lines.append(f"  fairness (Jain): {fairness:.2f} over "
+                     f"{len(shares)} device(s)")
+    return lines
+
+
 def _merges_section(totals: Dict[str, float]) -> List[str]:
     lines = ["", "== state-merge events (veritesting) =="]
     if not totals:
@@ -246,6 +273,7 @@ def report(events: List[dict], other: Dict[str, object]) -> str:
     tags = sum_series(counter_samples(events, TAGS_TRACK))
     merges = sum_series(counter_samples(events, MERGES_TRACK))
     fleet = sum_series(counter_samples(events, FLEET_TRACK))
+    shard = sum_series(counter_samples(events, SHARD_TRACK))
     n_counter = sum(1 for e in events if e.get("ph") == "C")
     lines.append(f"  counter samples: {n_counter} "
                  f"({len(lanes)} chunk(s) with lane telemetry)")
@@ -261,6 +289,7 @@ def report(events: List[dict], other: Dict[str, object]) -> str:
     lines.extend(_tags_section(tags))
     lines.extend(_merges_section(merges))
     lines.extend(_fleet_section(fleet))
+    lines.extend(_shard_section(shard))
     return "\n".join(lines)
 
 

@@ -1,0 +1,170 @@
+"""Multi-device sharded-fleet smoke for the pre-merge gate (MULTICHIP_r06).
+
+Forces a 2-virtual-CPU-device mesh (``jax_num_cpu_devices``, the same
+override __graft_entry__.dryrun_multichip uses) and exercises the two
+device-resident pieces of the mesh-sharded fleet frontier:
+
+1. **One sharded fleet step**: a fused symbolic chunk driven by a
+   2-shard scheduler (vector tops, segmented pools) — must run, keep
+   its per-shard counters finite, and leave the lane batch's status
+   multiset identical to the same chunk under the legacy scalar
+   scheduler (fresh empty pools on both sides, so only the pool
+   LAYOUT differs);
+2. **One steal exchange**: a forced imbalance (all pending rows in one
+   segment) across pool rows that are physically sharded over the two
+   devices — the steal pass must move rows through the packed wire
+   format bit-identically, conserve the row total, and raise Jain
+   fairness.
+
+xfail-style skips (exit 0 with a reason) on a CPU singleton — a jax
+build without the ``jax_num_cpu_devices`` config or a mesh that cannot
+reach 2 devices — mirroring tests/test_multichip.py's gating.
+
+Prints ``SHARD_SMOKE=ok`` on success; any failure exits non-zero.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("MYTHRIL_TPU_LANES", "8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+# virtual-device fallback for jax builds without the jax_num_cpu_devices
+# config option — must land in the environment before jax initializes a
+# backend, hence module scope ahead of any jax import
+_FLAGS = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _FLAGS:
+    os.environ["XLA_FLAGS"] = (
+        _FLAGS + " --xla_force_host_platform_device_count=2").strip()
+
+N_DEVICES = 2
+
+
+def _skip(reason: str) -> int:
+    print(f"shard_smoke: skipped — {reason}")
+    print("SHARD_SMOKE=skip")
+    return 0
+
+
+def main() -> int:
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", N_DEVICES)
+    except Exception:  # allowlisted: legacy jax uses the XLA_FLAGS path
+        pass
+
+    import numpy as np
+
+    jnp = jax.numpy
+    devices = jax.devices()
+    if len(devices) < N_DEVICES:
+        return _skip(f"need {N_DEVICES} devices, have {len(devices)}")
+
+    import __graft_entry__ as graft
+    from mythril_tpu.parallel import arena as parena
+    from mythril_tpu.parallel import frontier, symstep
+
+    # ---- 1. one sharded fleet step vs the legacy scalar scheduler ----------
+    n_lanes = int(os.environ["MYTHRIL_TPU_LANES"])
+    state, planes = graft._symbolic_batch(n_lanes)
+    arena = parena.new_arena(capacity=1 << 12, const_capacity=1 << 8)
+    sched = symstep.new_scheduler(state, planes, 2 * n_lanes, 2 * n_lanes,
+                                  n_shards=N_DEVICES)
+    sh_state, _, _, sh_sched = symstep.run_chunk(state, planes, arena,
+                                                 sched, 8)
+    jax.block_until_ready(sh_state.pc)
+    if sh_sched.stack_top.shape != (N_DEVICES,):
+        print(f"shard_smoke: sharded tops lost their shape: "
+              f"{sh_sched.stack_top.shape}", file=sys.stderr)
+        return 1
+
+    legacy = symstep.new_scheduler(state, planes, 2 * n_lanes, 2 * n_lanes)
+    ref_state, _, _, ref_sched = symstep.run_chunk(state, planes, arena,
+                                                   legacy, 8)
+    if int(sh_sched.executed) != int(ref_sched.executed):
+        print(f"shard_smoke: executed-step divergence: sharded "
+              f"{int(sh_sched.executed)} vs legacy {int(ref_sched.executed)}",
+              file=sys.stderr)
+        return 1
+    if sorted(np.asarray(sh_state.status).tolist()) \
+            != sorted(np.asarray(ref_state.status).tolist()):
+        print("shard_smoke: lane status multiset diverged between the "
+              "sharded and legacy schedulers", file=sys.stderr)
+        return 1
+
+    # ---- 2. one steal exchange across device-resident pool segments --------
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    pool_rows = 2 * n_lanes
+    seg = pool_rows // N_DEVICES
+    sched = symstep.new_scheduler(state, planes, pool_rows, pool_rows,
+                                  n_shards=N_DEVICES)
+    # recognizable pending rows, all parked in shard 1's segment
+    filled_state = jax.tree_util.tree_map(
+        lambda leaf: jnp.arange(int(np.prod(leaf.shape)), dtype=jnp.int64)
+        .reshape(leaf.shape).astype(leaf.dtype)
+        if leaf.dtype != jnp.bool_ else
+        (jnp.arange(int(np.prod(leaf.shape))).reshape(leaf.shape) % 2 == 0),
+        sched.stack_state)
+    sched = sched._replace(
+        stack_state=filled_state,
+        stack_top=jnp.asarray([0, seg], dtype=jnp.int32))
+
+    mesh = Mesh(np.array(devices[:N_DEVICES]), ("dev",))
+    row_sharding = NamedSharding(mesh, P("dev"))
+
+    def shard_rows(pytree):
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, row_sharding)
+            if getattr(leaf, "ndim", 0) >= 1
+            and leaf.shape[:1] == (pool_rows,) else leaf, pytree)
+
+    sched = sched._replace(stack_state=shard_rows(sched.stack_state),
+                           stack_planes=shard_rows(sched.stack_planes))
+    out = frontier._steal_compiled()(state, sched, min_imbalance=1,
+                                     max_rows=seg)
+    tops = np.asarray(out.stack_top)
+    if int(tops.sum()) != seg:
+        print(f"shard_smoke: steal pass lost rows: tops {tops.tolist()} "
+              f"sum != {seg}", file=sys.stderr)
+        return 1
+    moved = int(out.steal_rows)
+    if moved < 1 or int(np.asarray(out.steals_received)[0]) != moved:
+        print(f"shard_smoke: no rows moved to the starved shard "
+              f"(moved={moved}, recv={np.asarray(out.steals_received)})",
+              file=sys.stderr)
+        return 1
+    # the exchanged rows arrived bit-identically (donor top-down order)
+    old_pc = np.asarray(filled_state.pc)
+    new_pc = np.asarray(out.stack_state.pc)
+    for r in range(moved):
+        if new_pc[r] != old_pc[pool_rows - 1 - r]:
+            print(f"shard_smoke: stolen row {r} corrupted in transit "
+                  f"({new_pc[r]} != {old_pc[pool_rows - 1 - r]})",
+                  file=sys.stderr)
+            return 1
+
+    def jain(load):
+        square_sum = float(np.sum(load * load))
+        return (float(load.sum()) ** 2 / (len(load) * square_sum)
+                if square_sum else 1.0)
+
+    before = np.asarray([0, seg], dtype=np.float64)
+    if jain(tops.astype(np.float64)) <= jain(before):
+        print(f"shard_smoke: fairness did not rise: {before.tolist()} -> "
+              f"{tops.tolist()}", file=sys.stderr)
+        return 1
+
+    print(f"shard_smoke: {N_DEVICES}-device mesh — sharded chunk matched "
+          f"legacy ({int(sh_sched.executed)} steps), steal exchange moved "
+          f"{moved} row(s), tops {tops.tolist()}")
+    print("SHARD_SMOKE=ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
