@@ -93,13 +93,36 @@ def _branchy_contract(n_branches: int = N_BRANCHES) -> str:
     return "\n".join(lines)
 
 
-def _run_engine(engine: str, seconds: float):
+def _mem_branchy_contract(n_branches: int = 4) -> str:
+    """Function body: n sequential diamonds whose arms BOTH MSTORE a
+    different constant into the same 32-byte slot before reconverging.
+    The identical-memory gate blocks every join; the absint window
+    table lets the widened merge phase ITE-blend the slot instead.
+    The pad JUMPDEST equalizes the arms so fork siblings stay in
+    lockstep through each join."""
+    lines = []
+    for i in range(n_branches):
+        lines += [
+            f"PUSH2 {hex(4 + 32 * i)}", "CALLDATALOAD",
+            f"PUSH @t{i}", "JUMPI",
+            f"PUSH1 {hex(2 * i + 1)}", f"PUSH1 {hex(32 * i)}", "MSTORE",
+            f"PUSH @j{i}", "JUMP",
+            f"t{i}:", "JUMPDEST",
+            f"PUSH1 {hex(2 * i + 2)}", f"PUSH1 {hex(32 * i)}", "MSTORE",
+            "JUMPDEST",
+            f"j{i}:", "JUMPDEST",
+        ]
+    lines.append("STOP")
+    return "\n".join(lines)
+
+
+def _run_engine(engine: str, seconds: float, body: str = None):
     from mythril_tpu.analysis.symbolic import SymExecWrapper
     from mythril_tpu.frontends.asm import (assemble, creation_wrapper,
                                            dispatcher)
 
     creation = creation_wrapper(
-        assemble(dispatcher({"stress()": _branchy_contract()})))
+        assemble(dispatcher({"stress()": body or _branchy_contract()})))
     timeout = int(seconds)
     start = time.perf_counter()
     wrapper = SymExecWrapper(
@@ -427,6 +450,53 @@ def main():
            merge_events=merge_ab["on"]["merge_events"],
            lanes_retired=merge_ab["on"]["lanes_retired"])
 
+    # 3b'. memory-plane merge A/B (README "Value-range analysis"): the
+    #     reconverging tree again, but every diamond's arms BOTH write
+    #     a different word into the same memory slot — pairs the
+    #     identical-memory gate must block (blocked_by.memory) and the
+    #     absint window table statically unlocks (mem_blends). Same
+    #     chunk-4 setup as 3b; MYTHRIL_TPU_ABSINT=0 is the off side.
+    mem_body = _mem_branchy_contract()
+    os.environ["MYTHRIL_TPU_CHUNK"] = "4"
+    try:
+        os.environ["MYTHRIL_TPU_SKIP_HOST_DRAIN"] = "1"
+        with trace.span("bench.merge_mem_ab_warmup"):
+            _run_engine("tpu", 30, body=mem_body)
+        del os.environ["MYTHRIL_TPU_SKIP_HOST_DRAIN"]
+        metrics.reset("frontier.merge")
+        metrics.reset("absint")
+        with trace.span("bench.tpu_merge_mem_on"):
+            mem_on_rate, mem_on_info = _run_engine(
+                "tpu", ab_seconds, body=mem_body)
+        mem_snap_on = metrics.snapshot()
+        os.environ["MYTHRIL_TPU_ABSINT"] = "0"
+        metrics.reset("frontier.merge")
+        metrics.reset("absint")
+        with trace.span("bench.tpu_merge_mem_off"):
+            _mem_off_rate, mem_off_info = _run_engine(
+                "tpu", ab_seconds, body=mem_body)
+        mem_snap_off = metrics.snapshot()
+    finally:
+        os.environ.pop("MYTHRIL_TPU_ABSINT", None)
+        os.environ.pop("MYTHRIL_TPU_SKIP_HOST_DRAIN", None)
+        del os.environ["MYTHRIL_TPU_CHUNK"]
+    merge_mem_ab = {
+        "chunk": 4,
+        "on": {"states_per_sec": round(mem_on_rate, 1), **mem_on_info,
+               "mem_blends": int(mem_snap_on.get(
+                   "absint.merge.mem_blends", 0)),
+               "merge_events": int(mem_snap_on.get(
+                   "frontier.merge.events", 0))},
+        "off": {**mem_off_info,
+                "blocked_by_memory": int(mem_snap_off.get(
+                    "frontier.merge.blocked_by.memory", 0))},
+        "states_ratio": round(mem_off_info["states"]
+                              / max(mem_on_info["states"], 1), 2),
+    }
+    _phase("merge_mem_ab", states_ratio=merge_mem_ab["states_ratio"],
+           mem_blends=merge_mem_ab["on"]["mem_blends"],
+           blocked_by_memory=merge_mem_ab["off"]["blocked_by_memory"])
+
     # 3c. fleet A/B (README "Fleet mode"): the same mini-corpus as ONE
     #     packed device fleet vs the sequential per-contract loop. The
     #     decisive extra is mean dispatch-flush occupancy — the fleet's
@@ -593,6 +663,7 @@ def main():
             "tpu": tpu_info,
             "host": host_info,
             "merge_ab": merge_ab,
+            "merge_mem_ab": merge_mem_ab,
             "fleet_ab": fleet_ab,
         "shard_ab": shard_ab,
             "warm_start": warm_start_ab,
@@ -627,6 +698,7 @@ def main():
         "sym_tpu": tpu_info,
         "sym_host": host_info,
         "merge_ab": merge_ab,
+        "merge_mem_ab": merge_mem_ab,
         "fleet_ab": fleet_ab,
         "shard_ab": shard_ab,
         "warm_start": warm_start_ab,

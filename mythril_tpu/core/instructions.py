@@ -746,8 +746,17 @@ class Instruction:
         positive = Not(negated)
         states: List[GlobalState] = []
 
+        # range screen: the interval tables prove some conditions
+        # constant for EVERY execution of this site (e.g. out-of-range
+        # CALLDATALOAD selector compares) — the infeasible side would
+        # only ever produce an unsat branch, so it is dropped here
+        # before any constraint is appended or solver query issued.
+        # None -> both sides stay on their dynamic checks as before
+        range_verdict = cfa_screen.jumpi_verdict(
+            s.environment.code, s.get_current_instruction()["address"])
+
         # fall-through branch
-        if not negated.is_false:
+        if range_verdict is not True and not negated.is_false:
             negative_state = copy(s)
             negative_state.mstate.pc += 1
             negative_state.mstate.depth += 1  # depth = branches taken
@@ -755,7 +764,7 @@ class Instruction:
             states.append(negative_state)
 
         # taken branch
-        if not positive.is_false:
+        if range_verdict is not False and not positive.is_false:
             try:
                 jump_address = get_concrete_int(destination)
             except TypeError:

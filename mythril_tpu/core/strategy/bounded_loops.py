@@ -10,7 +10,10 @@ its edge count. States materialized from the device frontier inside a
 loop (parallel/frontier.py LoopHintAnnotation) seed that loop's count
 at 1 — the device already spent at least one unroll on them. JUMPDESTs
 outside any recovered loop keep the reference's per-(source, target)
-edge counting as the fallback."""
+edge counting as the fallback. Where the value-range pass proved an
+exact trip count (staticanalysis/absint.py, via
+cfa_screen.loop_bound_at) that bound replaces the flat default for the
+loop — a counting loop unrolls exactly as far as it provably runs."""
 
 from __future__ import annotations
 
@@ -88,7 +91,21 @@ class BoundedLoopsStrategy(BasicSearchStrategy):
                     state.environment.code, address)
             except Exception:  # no static tables for this code object
                 header = None
+            bound = self.bound
             if header == address:
+                # a statically proven trip count replaces the flat
+                # default for THIS loop: the interval prover counted
+                # header arrivals to the exit, which is exactly what
+                # this strategy counts (absint.loop_bounds_applied)
+                try:
+                    from ...smt.solver import cfa_screen
+
+                    proven = cfa_screen.loop_bound_at(
+                        state.environment.code, header)
+                except Exception:
+                    proven = None
+                if proven is not None:
+                    bound = max(1, proven)
                 # one arrival at the header = one unroll of THIS loop,
                 # whichever back edge (or the entry edge) got us here
                 key = -header - 1
@@ -104,8 +121,7 @@ class BoundedLoopsStrategy(BasicSearchStrategy):
                 key = self.calculate_hash(source, address)
             annotation._reached_count[key] = \
                 annotation._reached_count.get(key, 0) + 1
-            if annotation._reached_count[key] > self.bound:
-                log.debug("loop bound %d exceeded at %d", self.bound,
-                          address)
+            if annotation._reached_count[key] > bound:
+                log.debug("loop bound %d exceeded at %d", bound, address)
                 continue
             return state

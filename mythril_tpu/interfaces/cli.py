@@ -82,6 +82,15 @@ def _add_analysis_args(parser: argparse.ArgumentParser) -> None:
                               "(staticanalysis/taint.py): detection "
                               "modules register and fire on every hook "
                               "site again (A/B measurement)")
+    options.add_argument("--no-absint", action="store_true",
+                         help="disable the value-range/memory-region "
+                              "abstract interpretation "
+                              "(staticanalysis/absint.py): memory-plane "
+                              "merge widening, proven loop bounds, and "
+                              "constant-JUMPI pruning fall back to the "
+                              "identical-memory gate and flat defaults "
+                              "(A/B measurement; same as "
+                              "MYTHRIL_TPU_ABSINT=0)")
     options.add_argument("--no-frontier-telemetry", action="store_true",
                          help="compile the device-resident frontier "
                               "counter plane out of the fused step "

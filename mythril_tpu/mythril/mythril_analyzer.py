@@ -67,6 +67,7 @@ class MythrilAnalyzer:
         args.batch_solve = not getattr(cmd, "no_batch_solve", False)
         args.cfa = not getattr(cmd, "no_cfa", False)
         args.taint = not getattr(cmd, "no_taint", False)
+        args.absint = not getattr(cmd, "no_absint", False)
         args.frontier_telemetry = not getattr(
             cmd, "no_frontier_telemetry", False)
         args.state_merge = not getattr(cmd, "no_state_merge", False)

@@ -260,6 +260,25 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("frontier.merge.ite_depth", HISTOGRAM, "1",
                "Merge events by blended-slot count per pair (label = "
                "bucket, symstep.MERGE_DEPTH_LABELS)."),
+    MetricSpec("frontier.merge.blocked_by.memory", COUNTER, "1",
+               "Otherwise-mergeable sibling pairs blocked because their "
+               "concrete memory planes diverge outside any statically "
+               "proven join region (ROADMAP item 4 gate sizing)."),
+    MetricSpec("frontier.merge.blocked_by.mem_sym", COUNTER, "1",
+               "Otherwise-mergeable sibling pairs blocked because "
+               "diverged memory bytes carry symbolic-word encodings the "
+               "window blend cannot ITE (dirty/partial symbolic words)."),
+    MetricSpec("frontier.merge.blocked_by.storage_keys", COUNTER, "1",
+               "Otherwise-mergeable sibling pairs blocked because their "
+               "storage key sets differ (the blend covers values, not "
+               "key-set shape)."),
+    MetricSpec("frontier.merge.blocked_by.tstore", COUNTER, "1",
+               "Otherwise-mergeable sibling pairs blocked because their "
+               "transient-storage planes differ."),
+    MetricSpec("frontier.merge.blocked_by.depth", COUNTER, "1",
+               "Same-pc sibling pairs blocked because their path "
+               "conditions differ beyond the final fork (different conds "
+               "depths / prefixes — the partial-prefix merging gap)."),
     # -- checkpoints (support/checkpoint.py, parallel/frontier.py) ---------------
     MetricSpec("checkpoint.saves", COUNTER, "1",
                "Crash-safe checkpoint writes (host pickle + device npz)."),
@@ -305,6 +324,27 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("taint.frontier.loop_tagged", COUNTER, "1",
                "Materialized device lanes tagged with the natural-loop "
                "header their pc sits inside (bounded-unroll budgeting)."),
+    # -- value-range / memory-region absint (staticanalysis/absint.py) -----------
+    MetricSpec("absint.build_ms", HISTOGRAM, "ms",
+               "Wall time of one value-range/memory-region fixpoint "
+               "build (staticanalysis/absint.py)."),
+    MetricSpec("absint.widenings", COUNTER, "1",
+               "Interval widenings applied at loop headers (and "
+               "slow-converging joins) across absint builds."),
+    MetricSpec("absint.regions_proven", COUNTER, "1",
+               "Post-dominator join points whose diamond memory writes "
+               "the absint pass bounded to finite byte regions."),
+    MetricSpec("absint.merge.mem_blends", COUNTER, "1",
+               "32-byte memory words ITE-blended by the widened merge "
+               "phase (pairs that the identical-memory gate alone would "
+               "have blocked)."),
+    MetricSpec("absint.screen.range_answered", COUNTER, "1",
+               "JUMPI sites answered from the interval tables (provably "
+               "constant conditions — the infeasible side is dropped "
+               "before any constraint or solver work)."),
+    MetricSpec("absint.loop_bounds_applied", COUNTER, "1",
+               "Loop-header budget decisions where a statically proven "
+               "trip-count bound replaced the flat loop_bound default."),
     # -- device memory accounting (observe/export.py, sampled at scrape) ---------
     MetricSpec("device.hbm.bytes_in_use", GAUGE, "bytes",
                "Live HBM bytes across visible devices (jax "

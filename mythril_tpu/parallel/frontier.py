@@ -631,6 +631,13 @@ class _Frontier:
         self.state_merge = (
             tpu_config.get_flag("MYTHRIL_TPU_STATE_MERGE")
             and getattr(_support_args, "state_merge", True))
+        #: widened memory-plane merging: ship the absint join windows
+        #: (staticanalysis/absint.py via the CFA screen) to the merge
+        #: kernel so diamonds whose arms provably confine their writes
+        #: can ITE-blend memory. --no-absint / MYTHRIL_TPU_ABSINT=0
+        #: empty the table — the kernel compiles the widened phase out
+        #: and behaves byte-identically to the identical-memory gate.
+        self.absint = cfa_screen.absint_enabled()
         #: merge-tag occupancy (lane-visits per chunk at one merge point)
         #: that triggers a merge pass; the telemetry tag deltas are the
         #: trigger signal, so with telemetry off the pass falls back to a
@@ -806,16 +813,27 @@ class _Frontier:
     #: merge-attribution table cap (one P x K compare per merge round)
     MERGE_PC_SLOTS = 64
 
-    def _merge_pc_table(self) -> Tuple[np.ndarray, List[str]]:
+    def _merge_pc_table(
+            self) -> Tuple[np.ndarray, List[str], np.ndarray, np.ndarray]:
         """Post-dominator merge-point pcs for merge-event attribution
         (frontier.merge.tag_merges labels). Pairing itself keys on full
         state equality, so joins past the cap still merge — they just
-        land in the 'untagged' bucket."""
+        land in the 'untagged' bucket.
+
+        Also returns the widened-merge window table (mem_pcs i32[J],
+        mem_words i32[J, W] window start offsets, -1 padded): join pcs
+        where absint proved both diamond arms confine their memory
+        writes to a small set of 32-byte windows. Empty when the absint
+        screen is off — the kernel then compiles the widened phase out.
+        A stale or cross-contract row can only make the kernel's
+        containment check fail (missed blend), never corrupt a merge."""
         pcs: List[int] = []
         names: List[str] = []
         seen = set()
+        mem_map: Dict[int, Tuple[int, ...]] = {}
         for ctx in self.contexts:
-            cfa = cfa_screen.cfa_for(ctx.template.environment.code)
+            code = ctx.template.environment.code
+            cfa = cfa_screen.cfa_for(code)
             if cfa is None:
                 continue
             for pc in sorted(cfa.merge_points):
@@ -823,24 +841,54 @@ class _Frontier:
                     seen.add(pc)
                     pcs.append(pc)
                     names.append(f"merge@{pc:#x}")
+                if self.absint and pc not in mem_map:
+                    windows = cfa_screen.merge_mem_windows(code, pc)
+                    if windows:
+                        # one row per join-block pc the fact covers: the
+                        # merge cadence may run a chunk after the lanes
+                        # step off the join itself
+                        for row_pc in cfa_screen.merge_window_pcs(
+                                code, pc):
+                            mem_map.setdefault(row_pc, tuple(windows))
         pcs, names = pcs[:self.MERGE_PC_SLOTS], names[:self.MERGE_PC_SLOTS]
-        return np.asarray(pcs, dtype=np.int32), names
+        mem_items = sorted(mem_map.items())[:self.MERGE_PC_SLOTS]
+        if mem_items:
+            width = max(len(w) for _, w in mem_items)
+            mem_pcs = np.asarray([pc for pc, _ in mem_items],
+                                 dtype=np.int32)
+            mem_words = np.full((len(mem_items), width), -1,
+                                dtype=np.int32)
+            for i, (_, w) in enumerate(mem_items):
+                mem_words[i, :len(w)] = w
+        else:
+            mem_pcs = np.zeros(0, dtype=np.int32)
+            mem_words = np.zeros((0, 1), dtype=np.int32)
+        return np.asarray(pcs, dtype=np.int32), names, mem_pcs, mem_words
 
     def _publish_merge(self, mstats: np.ndarray,
                        merge_names: List[str]) -> None:
         """Decode one merge pass's stats vector (symstep.merge_pass:
-        [merges, ites, tag_hits[K], depth_hist]) into declared metrics
-        and a Perfetto counter track."""
+        [merges, ites, mem_blends, blocked_by[5], tag_hits[K],
+        depth_hist]) into declared metrics and a Perfetto counter
+        track."""
         fixed = symstep.MERGE_STATS_FIXED
         n_tags = len(merge_names)
         merges = int(mstats[0])
         metrics.inc("frontier.merge.passes")
+        # the blocked-by gate accounting publishes even on a 0-merge
+        # pass — "why did nothing merge" IS the 0-merge signal
+        for label, count in zip(symstep.MERGE_BLOCKED_LABELS, mstats[3:8]):
+            if count:
+                metrics.inc("frontier.merge.blocked_by." + label,
+                            int(count))
         if not merges:
             return
         self.merges += merges
         metrics.inc("frontier.merge.events", merges)
         metrics.inc("frontier.merge.lanes_retired", merges)
         metrics.inc("frontier.merge.ites", int(mstats[1]))
+        if int(mstats[2]):
+            metrics.inc("absint.merge.mem_blends", int(mstats[2]))
         tagged = 0
         for name, count in zip(merge_names, mstats[fixed:fixed + n_tags]):
             if count:
@@ -1082,8 +1130,10 @@ class _Frontier:
         # screen): attribution labels for frontier.merge.tag_merges. The
         # telemetry tag-occupancy deltas on these pcs are the trigger;
         # without them the pass runs on a fixed chunk cadence.
-        merge_pc_arr, merge_names = self._merge_pc_table() \
-            if self.state_merge else (np.zeros(0, np.int32), [])
+        merge_pc_arr, merge_names, mem_pc_arr, mem_word_arr = \
+            self._merge_pc_table() if self.state_merge else \
+            (np.zeros(0, np.int32), [], np.zeros(0, np.int32),
+             np.zeros((0, 1), np.int32))
         merge_by_tags = self.telemetry_enabled and any(
             name.startswith("merge@") for name in self.tag_names)
         # an unsatisfiable count trigger would silently degrade every drain
@@ -1266,6 +1316,7 @@ class _Frontier:
                         state, planes, self.arena, mstats = \
                             _merge_compiled()(
                                 state, planes, self.arena, merge_pc_arr,
+                                mem_pc_arr, mem_word_arr,
                                 n_rounds=_MERGE_ROUNDS)
                         # one small vector download, on triggered chunks
                         # only (the tunnel charges a ~30 ms floor)

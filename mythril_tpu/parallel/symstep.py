@@ -35,6 +35,7 @@ import numpy as np
 
 from . import arena as A
 from . import lockstep
+from . import words
 from .batch import (DEAD, ERRORED, ESCAPED, FORKING, RUNNING,
                     StateBatch)
 
@@ -1024,8 +1025,15 @@ def sym_step_many_counted(state: StateBatch, planes: SymPlanes,
 MERGE_DEPTH_LABELS = ("0", "1", "2", "3", "4-7", "8+")
 N_MERGE_DEPTH = len(MERGE_DEPTH_LABELS)
 
-#: merge-pass stats vector layout: [merges, ites, tag_hits[K], depth_hist]
-MERGE_STATS_FIXED = 2
+#: frontier.merge.blocked_by.* counter order in the stats vector — the
+#: accounting pass pairs reconverged-looking lanes that did NOT merge and
+#: charges each to the first gate that refused it
+MERGE_BLOCKED_LABELS = ("memory", "mem_sym", "storage_keys", "tstore",
+                        "depth")
+
+#: merge-pass stats vector layout:
+#: [merges, ites, mem_blends, blocked_by[5], tag_hits[K], depth_hist]
+MERGE_STATS_FIXED = 3 + len(MERGE_BLOCKED_LABELS)
 
 _H_PRIME = 1099511628211
 _H_MASK = (1 << 62) - 1
@@ -1047,176 +1055,372 @@ def _rows_equal(leaf, ti, fi):
 
 
 def merge_pass(state: StateBatch, planes: SymPlanes, arena: A.Arena,
-               merge_pcs: jnp.ndarray, n_rounds: int = 6
+               merge_pcs: jnp.ndarray,
+               mem_pcs: Optional[jnp.ndarray] = None,
+               mem_words: Optional[jnp.ndarray] = None,
+               n_rounds: int = 6
                ) -> Tuple[StateBatch, SymPlanes, A.Arena, jnp.ndarray]:
     """Collapse reconverged fork-sibling lanes; `n_rounds` greedy pairing
     rounds per invocation (each round merges one level of the fork tree).
     `merge_pcs` (i32[K] post-dominator merge points from staticanalysis/)
     attributes merge events to tags for telemetry; pairing itself keys on
     full state equality, which subsumes "reconverged at the join".
-    Returns (state, planes, arena, stats i64[2 + K + N_MERGE_DEPTH])."""
+
+    `mem_pcs` (i32[J]) and `mem_words` (i32[J, W], -1 padded) are the
+    absint join table: join pcs whose diamond arms provably confine their
+    memory writes to the listed 32-byte-aligned windows. When non-empty, a
+    second widened pairing phase runs at exactly those pcs with the
+    identical-memory requirement relaxed: sibling pairs whose byte/plane
+    diffs all land inside the windows get each differing window ITE-blended
+    through a fresh symbolic word (mem_sym cells (node<<5)+j — the same
+    pattern a symbolic MSTORE leaves, so MLOAD round-trips stay clean).
+    The containment and blendability checks run on the live planes, so a
+    wrong window table can only MISS a blend, never corrupt one.
+
+    A final accounting pass pairs reconverged-looking lanes that did NOT
+    merge and charges each to the first gate that refused it
+    (MERGE_BLOCKED_LABELS order in the stats vector).
+
+    Returns (state, planes, arena,
+    stats i64[MERGE_STATS_FIXED + K + N_MERGE_DEPTH])."""
     batch = state.pc.shape[0]
     half = batch // 2
     slots = planes.stack_sym.shape[1]
     kslots = planes.storage_sym.shape[1]
     max_conds = planes.conds.shape[1]
+    mem_cap = planes.mem_sym.shape[1]
     n_tags = merge_pcs.shape[0]
     lane = jnp.arange(batch)
+    j32 = jnp.arange(32, dtype=I32)
+    if mem_pcs is None:
+        mem_pcs = jnp.zeros(0, dtype=I32)
+        mem_words = jnp.zeros((0, 1), dtype=I32)
+    mem_pcs = jnp.asarray(mem_pcs, dtype=I32)
+    mem_words = jnp.asarray(mem_words, dtype=I32)
+    n_wins = mem_words.shape[1]
 
     # leaves a merge must find identical (everything else is blended or
     # recomputed). Immutable template planes — code, calldata, env words,
     # gas_limit — are covered by ctx_id equality: lanes with one ctx_id
     # were row-copied from one seed template and no device op writes them.
-    # Transient storage is required equal rather than blended (rare).
-    eq_leaves = (state.pc, state.sp, state.msize, state.code_len,
-                 state.retdata_len, state.retdata, state.memory,
-                 state.storage_keys, state.storage_used,
-                 state.tstore_keys, state.tstore_vals, state.tstore_used,
-                 planes.mem_sym, planes.storage_base_sym,
-                 planes.symbolic_env, planes.ctx_id)
-    static_h = jnp.zeros(batch, dtype=jnp.int64)
-    for leaf in eq_leaves:
+    # Transient storage is required equal rather than blended (rare). The
+    # memory planes sit in their own tuple: the widened phase relaxes
+    # exactly those two while requiring everything else identical.
+    eq_leaves_weak = (state.pc, state.sp, state.msize, state.code_len,
+                      state.retdata_len, state.retdata,
+                      state.storage_keys, state.storage_used,
+                      state.tstore_keys, state.tstore_vals,
+                      state.tstore_used, planes.storage_base_sym,
+                      planes.symbolic_env, planes.ctx_id)
+    eq_leaves_mem = (state.memory, planes.mem_sym)
+    weak_h = jnp.zeros(batch, dtype=jnp.int64)
+    for leaf in eq_leaves_weak:
+        weak_h = _merge_fold(weak_h, leaf)
+    static_h = weak_h
+    for leaf in eq_leaves_mem:
         static_h = _merge_fold(static_h, leaf)
 
     stats0 = jnp.zeros(MERGE_STATS_FIXED + n_tags + N_MERGE_DEPTH,
                        dtype=jnp.int64)
 
-    def one_round(r, carry):
-        state, planes, arena, stats = carry
-        cc = planes.cond_count
-        last_idx = jnp.clip(cc - 1, 0, max_conds - 1)
-        last = planes.conds[lane, last_idx]
-        sign = (last > 0).astype(jnp.int64)
-        # partners share |last| — hash with the sign stripped, sort on it
-        conds_abs = planes.conds.at[lane, last_idx].set(jnp.abs(last))
-        eligible = (state.status == RUNNING) & (cc > 0) & (last != 0) \
-            & (planes.fork_cond == 0)
+    def make_round(widen_mem):
+        def one_round(r, carry):
+            state, planes, arena, stats = carry
+            cc = planes.cond_count
+            last_idx = jnp.clip(cc - 1, 0, max_conds - 1)
+            last = planes.conds[lane, last_idx]
+            sign = (last > 0).astype(jnp.int64)
+            # partners share |last| — hash with the sign stripped, sort on it
+            conds_abs = planes.conds.at[lane, last_idx].set(jnp.abs(last))
+            eligible = (state.status == RUNNING) & (cc > 0) & (last != 0) \
+                & (planes.fork_cond == 0)
+            if widen_mem:
+                # widened pairing happens ONLY at the proven join pcs
+                at_join = state.pc[:, None] == mem_pcs[None, :]
+                eligible &= jnp.any(at_join, axis=1)
+                join_row = jnp.argmax(at_join, axis=1)
+                base_h = weak_h
+            else:
+                base_h = static_h
 
-        h = _merge_fold(static_h, conds_abs)
-        h = h * jnp.int64(_H_PRIME) + cc.astype(jnp.int64)
-        key = jnp.where(eligible, ((h & jnp.int64(_H_MASK)) << 1) | sign,
-                        jnp.int64(0x7FFFFFFFFFFFFFFF))
-        perm = jnp.argsort(key)
-        # alternate pair alignment by round so an unpaired singleton can
-        # never shadow the same candidate pair across every round
-        perm = jnp.roll(perm, -(r % 2))
-        fi = perm[0:2 * half:2]   # sorts first in a group: last cond < 0
-        ti = perm[1:2 * half:2]   # last cond > 0 — the merge survivor
+            h = _merge_fold(base_h, conds_abs)
+            h = h * jnp.int64(_H_PRIME) + cc.astype(jnp.int64)
+            key = jnp.where(eligible, ((h & jnp.int64(_H_MASK)) << 1) | sign,
+                            jnp.int64(0x7FFFFFFFFFFFFFFF))
+            perm = jnp.argsort(key)
+            # alternate pair alignment by round so an unpaired singleton can
+            # never shadow the same candidate pair across every round
+            perm = jnp.roll(perm, -(r % 2))
+            fi = perm[0:2 * half:2]   # sorts first in a group: last cond < 0
+            ti = perm[1:2 * half:2]   # last cond > 0 — the merge survivor
 
-        ok = eligible[ti] & eligible[fi]
-        last_t = last[ti]
-        ok &= (last_t > 0) & (last_t == -last[fi])
-        ok &= cc[ti] == cc[fi]
-        ok &= jnp.all(conds_abs[ti] == conds_abs[fi], axis=1)
-        for leaf in eq_leaves:
-            ok &= _rows_equal(leaf, ti, fi)
+            ok = eligible[ti] & eligible[fi]
+            last_t = last[ti]
+            ok &= (last_t > 0) & (last_t == -last[fi])
+            ok &= cc[ti] == cc[fi]
+            ok &= jnp.all(conds_abs[ti] == conds_abs[fi], axis=1)
+            for leaf in eq_leaves_weak:
+                ok &= _rows_equal(leaf, ti, fi)
+            if not widen_mem:
+                for leaf in eq_leaves_mem:
+                    ok &= _rows_equal(leaf, ti, fi)
 
-        # ---- blend differing stack slots through ite(cond, then, else) ------
-        # cond is the survivor's positive last condition, so the taken
-        # side's value is the `then` child (op 0x0F: a != 0 -> b else c).
-        # Slots whose sym nodes agree need no blend — when nonzero the sym
-        # node governs materialization and the concrete word is dead.
-        sp_t = state.sp[ti]
-        sym_t, sym_f = planes.stack_sym[ti], planes.stack_sym[fi]
-        conc_t, conc_f = state.stack[ti], state.stack[fi]
-        live = jnp.arange(slots)[None, :] < sp_t[:, None]
-        sdiff = ok[:, None] & live & (
-            (sym_t != sym_f)
-            | ((sym_t == 0) & (sym_f == 0)
-               & jnp.any(conc_t != conc_f, axis=-1)))
-        limbs = state.stack.shape[-1]
-        arena, cid_t, ovf1 = A.alloc_consts(
-            arena, (sdiff & (sym_t == 0)).reshape(-1),
-            conc_t.reshape(half * slots, limbs))
-        arena, cid_f, ovf2 = A.alloc_consts(
-            arena, (sdiff & (sym_f == 0)).reshape(-1),
-            conc_f.reshape(half * slots, limbs))
-        node_t = jnp.where(sym_t.reshape(-1) != 0, sym_t.reshape(-1), cid_t)
-        node_f = jnp.where(sym_f.reshape(-1) != 0, sym_f.reshape(-1), cid_f)
-        cond_b = jnp.broadcast_to(last_t[:, None],
-                                  (half, slots)).reshape(-1)
-        zero = jnp.zeros_like(node_t)
-        arena, ite_s, ovf3 = A.alloc_rows(
-            arena, sdiff.reshape(-1), jnp.full_like(node_t, 0x0F),
-            cond_b, node_t, node_f, zero, zero)
-        stack_ovf = (ovf1 | ovf2 | ovf3).reshape(half, slots)
+            if widen_mem:
+                # ---- memory-window containment + blendability ---------------
+                # every differing byte/plane cell must fall inside a valid
+                # window of the pair's join, and each differing window must
+                # read back as ONE well-defined 256-bit word on both sides:
+                # fully concrete (no sym marks) or a clean symbolic word.
+                # Windows are non-overlapping by construction (absint
+                # word_windows), so per-window diff counts add up exactly.
+                wins = mem_words[join_row[ti]]              # i32[half, W]
+                valid_w = (wins >= 0) & (wins + 32 <= mem_cap)
+                idx = wins[:, :, None] + j32[None, None, :]  # [half, W, 32]
+                safe = jnp.clip(idx, 0, mem_cap - 1).reshape(half, -1)
 
-        # ---- blend differing storage slots (keys/used verified equal) -------
-        ksym_t, ksym_f = planes.storage_sym[ti], planes.storage_sym[fi]
-        kval_t, kval_f = state.storage_vals[ti], state.storage_vals[fi]
-        kdiff = ok[:, None] & state.storage_used[ti] & (
-            (ksym_t != ksym_f)
-            | ((ksym_t == 0) & (ksym_f == 0)
-               & jnp.any(kval_t != kval_f, axis=-1)))
-        arena, kid_t, ovf4 = A.alloc_consts(
-            arena, (kdiff & (ksym_t == 0)).reshape(-1),
-            kval_t.reshape(half * kslots, limbs))
-        arena, kid_f, ovf5 = A.alloc_consts(
-            arena, (kdiff & (ksym_f == 0)).reshape(-1),
-            kval_f.reshape(half * kslots, limbs))
-        knode_t = jnp.where(ksym_t.reshape(-1) != 0, ksym_t.reshape(-1),
-                            kid_t)
-        knode_f = jnp.where(ksym_f.reshape(-1) != 0, ksym_f.reshape(-1),
-                            kid_f)
-        kcond_b = jnp.broadcast_to(last_t[:, None],
-                                   (half, kslots)).reshape(-1)
-        kzero = jnp.zeros_like(knode_t)
-        arena, ite_k, ovf6 = A.alloc_rows(
-            arena, kdiff.reshape(-1), jnp.full_like(knode_t, 0x0F),
-            kcond_b, knode_t, knode_f, kzero, kzero)
-        storage_ovf = (ovf4 | ovf5 | ovf6).reshape(half, kslots)
+                def win_gather(plane, rows):
+                    return jnp.take_along_axis(
+                        plane[rows], safe, axis=1).reshape(half, n_wins, 32)
 
-        # arena exhaustion mid-blend: cancel the pair (both lanes keep
-        # exploring — a missed merge is a perf loss, never a lost path)
-        merged = ok & ~jnp.any(stack_ovf, axis=1) \
-            & ~jnp.any(storage_ovf, axis=1)
+                mem_tg = win_gather(state.memory, ti)
+                mem_fg = win_gather(state.memory, fi)
+                sym_tg = win_gather(planes.mem_sym, ti)
+                sym_fg = win_gather(planes.mem_sym, fi)
+                mdiff_all = (state.memory[ti] != state.memory[fi]) \
+                    | (planes.mem_sym[ti] != planes.mem_sym[fi])
+                wdiff_cells = ((mem_tg != mem_fg) | (sym_tg != sym_fg)) \
+                    & valid_w[:, :, None]
+                contained = jnp.sum(mdiff_all, axis=1) \
+                    == jnp.sum(wdiff_cells, axis=(1, 2))
+                wdiff = jnp.any(wdiff_cells, axis=2)        # [half, W]
 
-        # ---- apply: rewrite the survivor, retire the partner ----------------
-        tset = jnp.where(merged, ti, batch).astype(I32)
-        fset = jnp.where(merged, fi, batch).astype(I32)
-        m2 = merged[:, None]
-        stack_sym = planes.stack_sym.at[tset].set(
-            jnp.where(sdiff & m2, ite_s.reshape(half, slots), sym_t),
-            mode="drop")
-        storage_sym = planes.storage_sym.at[tset].set(
-            jnp.where(kdiff & m2, ite_k.reshape(half, kslots), ksym_t),
-            mode="drop")
-        # either side's dirty writes must materialize from the survivor
-        storage_dirty = planes.storage_dirty.at[tset].set(
-            planes.storage_dirty[ti] | planes.storage_dirty[fi],
-            mode="drop")
-        conds = planes.conds.at[tset, last_idx[ti]].set(0, mode="drop")
-        cond_count = planes.cond_count.at[tset].set(cc[ti] - 1, mode="drop")
-        # deeper side wins: host depth bounds stay conservative
-        branches = planes.branches.at[tset].set(
-            jnp.maximum(planes.branches[ti], planes.branches[fi]),
-            mode="drop")
-        status = state.status.at[fset].set(I32(DEAD), mode="drop")
-        gas = state.gas_used.at[tset].set(
-            jnp.maximum(state.gas_used[ti], state.gas_used[fi]),
-            mode="drop")
-        state = state._replace(status=status, gas_used=gas)
-        planes = planes._replace(
-            stack_sym=stack_sym, storage_sym=storage_sym,
-            storage_dirty=storage_dirty, conds=conds,
-            cond_count=cond_count, branches=branches)
+                def word_view(sym_g):
+                    all0 = jnp.all(sym_g == 0, axis=2)
+                    first = sym_g[:, :, 0]
+                    clean = (first != 0) & ((first & 31) == 0) & jnp.all(
+                        sym_g == first[:, :, None] + j32[None, None, :],
+                        axis=2)
+                    return all0, first, clean
 
-        # ---- stats ----------------------------------------------------------
-        depth = jnp.sum(sdiff & m2, axis=1) + jnp.sum(kdiff & m2, axis=1)
-        stats = stats.at[0].add(jnp.sum(merged, dtype=jnp.int64))
-        stats = stats.at[1].add(jnp.sum(depth, dtype=jnp.int64))
-        if n_tags:
-            pc_t = state.pc[ti]
-            stats = stats.at[MERGE_STATS_FIXED:
-                             MERGE_STATS_FIXED + n_tags].add(jnp.sum(
-                                 merged[:, None]
-                                 & (pc_t[:, None] == merge_pcs[None, :]),
-                                 axis=0, dtype=jnp.int64))
-        bucket = jnp.where(depth >= 8, 5, jnp.where(depth >= 4, 4, depth))
-        stats = stats.at[jnp.where(
-            merged, MERGE_STATS_FIXED + n_tags + bucket,
-            stats.shape[0])].add(jnp.int64(1), mode="drop")
-        return state, planes, arena, stats
+                all0_t, first_t, clean_t = word_view(sym_tg)
+                all0_f, first_f, clean_f = word_view(sym_fg)
+                blendable = (all0_t | clean_t) & (all0_f | clean_f)
+                ok &= contained
+                ok &= jnp.all(~(wdiff & valid_w) | blendable, axis=1)
+                need = wdiff & valid_w & ok[:, None]
 
-    return jax.lax.fori_loop(0, n_rounds, one_round,
-                             (state, planes, arena, stats0))
+                # per-window value nodes: the clean word's node, else a
+                # fresh CONST wrapping the window's concrete bytes
+                word_t = words.from_bytes(mem_tg)
+                word_f = words.from_bytes(mem_fg)
+                arena, mcid_t, movf1 = A.alloc_consts(
+                    arena, (need & all0_t).reshape(-1),
+                    word_t.reshape(half * n_wins, -1))
+                arena, mcid_f, movf2 = A.alloc_consts(
+                    arena, (need & all0_f).reshape(-1),
+                    word_f.reshape(half * n_wins, -1))
+                mnode_t = jnp.where(all0_t.reshape(-1), mcid_t,
+                                    (first_t >> 5).reshape(-1))
+                mnode_f = jnp.where(all0_f.reshape(-1), mcid_f,
+                                    (first_f >> 5).reshape(-1))
+                mcond = jnp.broadcast_to(last_t[:, None],
+                                         (half, n_wins)).reshape(-1)
+                mzero = jnp.zeros_like(mnode_t)
+                arena, ite_m, movf3 = A.alloc_rows(
+                    arena, need.reshape(-1), jnp.full_like(mnode_t, 0x0F),
+                    mcond, mnode_t, mnode_f, mzero, mzero)
+                mem_ovf = (movf1 | movf2 | movf3).reshape(half, n_wins)
+
+            # ---- blend differing stack slots through ite(cond, then, else) --
+            # cond is the survivor's positive last condition, so the taken
+            # side's value is the `then` child (op 0x0F: a != 0 -> b else c).
+            # Slots whose sym nodes agree need no blend — when nonzero the
+            # sym node governs materialization and the concrete word is dead.
+            sp_t = state.sp[ti]
+            sym_t, sym_f = planes.stack_sym[ti], planes.stack_sym[fi]
+            conc_t, conc_f = state.stack[ti], state.stack[fi]
+            live = jnp.arange(slots)[None, :] < sp_t[:, None]
+            sdiff = ok[:, None] & live & (
+                (sym_t != sym_f)
+                | ((sym_t == 0) & (sym_f == 0)
+                   & jnp.any(conc_t != conc_f, axis=-1)))
+            limbs = state.stack.shape[-1]
+            arena, cid_t, ovf1 = A.alloc_consts(
+                arena, (sdiff & (sym_t == 0)).reshape(-1),
+                conc_t.reshape(half * slots, limbs))
+            arena, cid_f, ovf2 = A.alloc_consts(
+                arena, (sdiff & (sym_f == 0)).reshape(-1),
+                conc_f.reshape(half * slots, limbs))
+            node_t = jnp.where(sym_t.reshape(-1) != 0, sym_t.reshape(-1),
+                               cid_t)
+            node_f = jnp.where(sym_f.reshape(-1) != 0, sym_f.reshape(-1),
+                               cid_f)
+            cond_b = jnp.broadcast_to(last_t[:, None],
+                                      (half, slots)).reshape(-1)
+            zero = jnp.zeros_like(node_t)
+            arena, ite_s, ovf3 = A.alloc_rows(
+                arena, sdiff.reshape(-1), jnp.full_like(node_t, 0x0F),
+                cond_b, node_t, node_f, zero, zero)
+            stack_ovf = (ovf1 | ovf2 | ovf3).reshape(half, slots)
+
+            # ---- blend differing storage slots (keys/used verified equal) ---
+            ksym_t, ksym_f = planes.storage_sym[ti], planes.storage_sym[fi]
+            kval_t, kval_f = state.storage_vals[ti], state.storage_vals[fi]
+            kdiff = ok[:, None] & state.storage_used[ti] & (
+                (ksym_t != ksym_f)
+                | ((ksym_t == 0) & (ksym_f == 0)
+                   & jnp.any(kval_t != kval_f, axis=-1)))
+            arena, kid_t, ovf4 = A.alloc_consts(
+                arena, (kdiff & (ksym_t == 0)).reshape(-1),
+                kval_t.reshape(half * kslots, limbs))
+            arena, kid_f, ovf5 = A.alloc_consts(
+                arena, (kdiff & (ksym_f == 0)).reshape(-1),
+                kval_f.reshape(half * kslots, limbs))
+            knode_t = jnp.where(ksym_t.reshape(-1) != 0, ksym_t.reshape(-1),
+                                kid_t)
+            knode_f = jnp.where(ksym_f.reshape(-1) != 0, ksym_f.reshape(-1),
+                                kid_f)
+            kcond_b = jnp.broadcast_to(last_t[:, None],
+                                       (half, kslots)).reshape(-1)
+            kzero = jnp.zeros_like(knode_t)
+            arena, ite_k, ovf6 = A.alloc_rows(
+                arena, kdiff.reshape(-1), jnp.full_like(knode_t, 0x0F),
+                kcond_b, knode_t, knode_f, kzero, kzero)
+            storage_ovf = (ovf4 | ovf5 | ovf6).reshape(half, kslots)
+
+            # arena exhaustion mid-blend: cancel the pair (both lanes keep
+            # exploring — a missed merge is a perf loss, never a lost path)
+            merged = ok & ~jnp.any(stack_ovf, axis=1) \
+                & ~jnp.any(storage_ovf, axis=1)
+            if widen_mem:
+                merged &= ~jnp.any(mem_ovf, axis=1)
+
+            # ---- apply: rewrite the survivor, retire the partner ------------
+            tset = jnp.where(merged, ti, batch).astype(I32)
+            fset = jnp.where(merged, fi, batch).astype(I32)
+            m2 = merged[:, None]
+            stack_sym = planes.stack_sym.at[tset].set(
+                jnp.where(sdiff & m2, ite_s.reshape(half, slots), sym_t),
+                mode="drop")
+            storage_sym = planes.storage_sym.at[tset].set(
+                jnp.where(kdiff & m2, ite_k.reshape(half, kslots), ksym_t),
+                mode="drop")
+            # either side's dirty writes must materialize from the survivor
+            storage_dirty = planes.storage_dirty.at[tset].set(
+                planes.storage_dirty[ti] | planes.storage_dirty[fi],
+                mode="drop")
+            conds = planes.conds.at[tset, last_idx[ti]].set(0, mode="drop")
+            cond_count = planes.cond_count.at[tset].set(cc[ti] - 1,
+                                                        mode="drop")
+            # deeper side wins: host depth bounds stay conservative
+            branches = planes.branches.at[tset].set(
+                jnp.maximum(planes.branches[ti], planes.branches[fi]),
+                mode="drop")
+            status = state.status.at[fset].set(I32(DEAD), mode="drop")
+            gas = state.gas_used.at[tset].set(
+                jnp.maximum(state.gas_used[ti], state.gas_used[fi]),
+                mode="drop")
+            state = state._replace(status=status, gas_used=gas)
+            mem_sym = planes.mem_sym
+            if widen_mem:
+                # survivor's differing windows become clean symbolic words
+                # over the ITE node — the survivor's stale concrete bytes
+                # are dead wherever a mark is set (MLOAD reads the node)
+                blend3 = (need & merged[:, None])[:, :, None] \
+                    & jnp.broadcast_to(True, idx.shape)
+                cells = (ite_m.reshape(half, n_wins)[:, :, None] << 5) \
+                    + j32[None, None, :]
+                rows3 = jnp.broadcast_to(tset[:, None, None], idx.shape)
+                cols3 = jnp.where(blend3, idx, mem_cap).astype(I32)
+                mem_sym = mem_sym.at[rows3, cols3].set(cells, mode="drop")
+            planes = planes._replace(
+                stack_sym=stack_sym, storage_sym=storage_sym,
+                storage_dirty=storage_dirty, conds=conds,
+                cond_count=cond_count, branches=branches, mem_sym=mem_sym)
+
+            # ---- stats ------------------------------------------------------
+            depth = jnp.sum(sdiff & m2, axis=1) + jnp.sum(kdiff & m2, axis=1)
+            if widen_mem:
+                depth = depth + jnp.sum(need & m2, axis=1)
+                stats = stats.at[2].add(jnp.sum(
+                    merged & jnp.any(need, axis=1), dtype=jnp.int64))
+            stats = stats.at[0].add(jnp.sum(merged, dtype=jnp.int64))
+            stats = stats.at[1].add(jnp.sum(depth, dtype=jnp.int64))
+            if n_tags:
+                pc_t = state.pc[ti]
+                stats = stats.at[MERGE_STATS_FIXED:
+                                 MERGE_STATS_FIXED + n_tags].add(jnp.sum(
+                                     merged[:, None]
+                                     & (pc_t[:, None] == merge_pcs[None, :]),
+                                     axis=0, dtype=jnp.int64))
+            bucket = jnp.where(depth >= 8, 5, jnp.where(depth >= 4, 4,
+                                                        depth))
+            stats = stats.at[jnp.where(
+                merged, MERGE_STATS_FIXED + n_tags + bucket,
+                stats.shape[0])].add(jnp.int64(1), mode="drop")
+            return state, planes, arena, stats
+
+        return one_round
+
+    carry = jax.lax.fori_loop(0, n_rounds, make_round(False),
+                              (state, planes, arena, stats0))
+    if mem_pcs.shape[0]:
+        # widened phase AFTER the strict rounds: strict merges are cheaper
+        # (no arena traffic for memory) and collapsing them first lets the
+        # widened rounds pair the fresh survivors bottom-up too
+        carry = jax.lax.fori_loop(0, n_rounds, make_round(True), carry)
+    state, planes, arena, stats = carry
+
+    # ---- blocked-by accounting ----------------------------------------------
+    # pair lanes whose CORE state (pc/sp/sizes/ctx — no conds, no mutable
+    # planes) matches and that still did not merge; charge each pair to the
+    # first gate that refused it. Pure telemetry: no state is modified.
+    cc = planes.cond_count
+    last_idx = jnp.clip(cc - 1, 0, max_conds - 1)
+    last = planes.conds[lane, last_idx]
+    sign = (last > 0).astype(jnp.int64)
+    conds_abs = planes.conds.at[lane, last_idx].set(jnp.abs(last))
+    eligible = (state.status == RUNNING) & (cc > 0) & (last != 0) \
+        & (planes.fork_cond == 0)
+    core_h = jnp.zeros(batch, dtype=jnp.int64)
+    for leaf in (state.pc, state.sp, state.msize, state.code_len,
+                 state.retdata_len, state.retdata, planes.symbolic_env,
+                 planes.ctx_id):
+        core_h = _merge_fold(core_h, leaf)
+    key = jnp.where(eligible, ((core_h & jnp.int64(_H_MASK)) << 1) | sign,
+                    jnp.int64(0x7FFFFFFFFFFFFFFF))
+    perm = jnp.argsort(key)
+    fi = perm[0:2 * half:2]
+    ti = perm[1:2 * half:2]
+    cand = eligible[ti] & eligible[fi]
+    for leaf in (state.pc, state.sp, state.msize, state.code_len,
+                 state.retdata_len, state.retdata, planes.symbolic_env,
+                 planes.ctx_id):
+        cand &= _rows_equal(leaf, ti, fi)
+    # gate 1: fork siblinghood — same condition prefix, opposite last sign
+    sib = (last[ti] > 0) & (last[ti] == -last[fi]) & (cc[ti] == cc[fi]) \
+        & jnp.all(conds_abs[ti] == conds_abs[fi], axis=1)
+    blocked_depth = cand & ~sib
+    rest = cand & sib
+    # gate 2: storage shape (differing VALUES would have blended)
+    keys_eq = _rows_equal(state.storage_keys, ti, fi) \
+        & _rows_equal(state.storage_used, ti, fi) \
+        & _rows_equal(planes.storage_base_sym, ti, fi)
+    blocked_storage = rest & ~keys_eq
+    rest &= keys_eq
+    # gate 3: transient storage (required equal, never blended)
+    ts_eq = _rows_equal(state.tstore_keys, ti, fi) \
+        & _rows_equal(state.tstore_vals, ti, fi) \
+        & _rows_equal(state.tstore_used, ti, fi)
+    blocked_tstore = rest & ~ts_eq
+    rest &= ts_eq
+    # gate 4: the memory planes — split on whether symbolic marks differ
+    msym_eq = _rows_equal(planes.mem_sym, ti, fi)
+    mem_eq = _rows_equal(state.memory, ti, fi)
+    blocked_mem_sym = rest & ~msym_eq
+    blocked_mem = rest & msym_eq & ~mem_eq
+    for slot, blocked in ((3, blocked_mem), (4, blocked_mem_sym),
+                          (5, blocked_storage), (6, blocked_tstore),
+                          (7, blocked_depth)):
+        stats = stats.at[slot].add(jnp.sum(blocked, dtype=jnp.int64))
+    return state, planes, arena, stats

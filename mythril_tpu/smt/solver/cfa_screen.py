@@ -25,7 +25,7 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 from ...observe import metrics
-from ...staticanalysis import CfaResult, get_cfa
+from ...staticanalysis import AbsintResult, CfaResult, get_absint, get_cfa
 from ...support import tpu_config
 from ...support.support_args import args
 
@@ -38,6 +38,12 @@ __all__ = [
     "statically_dead",
     "block_key",
     "warm",
+    "absint_enabled",
+    "absint_for",
+    "jumpi_verdict",
+    "loop_bound_at",
+    "merge_mem_windows",
+    "merge_window_pcs",
 ]
 
 
@@ -45,6 +51,13 @@ def enabled() -> bool:
     """The screen is live: neither --no-cfa nor MYTHRIL_TPU_CFA=0."""
     return bool(getattr(args, "cfa", True)) \
         and tpu_config.get_flag("MYTHRIL_TPU_CFA")
+
+
+def absint_enabled() -> bool:
+    """The value-range screen is live: the cfa screen is on AND neither
+    --no-absint nor MYTHRIL_TPU_ABSINT=0."""
+    return enabled() and bool(getattr(args, "absint", True)) \
+        and tpu_config.get_flag("MYTHRIL_TPU_ABSINT")
 
 
 def cfa_for(disassembly) -> Optional[CfaResult]:
@@ -57,8 +70,10 @@ def cfa_for(disassembly) -> Optional[CfaResult]:
 
 def warm(disassembly) -> None:
     """Build the tables eagerly (e.g. at frontier seed time) so the
-    first screened jump doesn't pay the build inside the step loop."""
+    first screened jump doesn't pay the build inside the step loop.
+    Warms the absint tables too when that screen is live."""
     cfa_for(disassembly)
+    absint_for(disassembly)
 
 
 def screen_jump_target(disassembly, jump_address: int) -> Optional[bool]:
@@ -106,6 +121,92 @@ def statically_dead(disassembly, pc: int) -> bool:
     """True only when `pc` is PROVEN unreachable (False = no claim)."""
     result = cfa_for(disassembly)
     return bool(result is not None and result.is_dead(pc))
+
+
+def absint_for(disassembly) -> Optional[AbsintResult]:
+    """The (memoized) value-range/memory-region tables for a contract,
+    or None when the absint screen is off or the fixpoint bailed."""
+    if disassembly is None or not absint_enabled():
+        return None
+    return get_absint(disassembly)
+
+
+def jumpi_verdict(disassembly, site_pc: int) -> Optional[bool]:
+    """Static branch-direction verdict for the JUMPI at `site_pc`.
+
+    True  -> the condition is provably always nonzero (always taken);
+    False -> provably always zero (never taken);
+    None  -> no verdict (screen off, bailed, data-dependent condition).
+
+    Every non-None answer is counted (``absint.screen.range_answered``)
+    — the infeasible side is dropped before any constraint is appended
+    or solver query issued."""
+    result = absint_for(disassembly)
+    if result is None:
+        return None
+    verdict = result.jumpi_verdict(site_pc)
+    if verdict is not None:
+        metrics.inc("absint.screen.range_answered")
+    return verdict
+
+
+def loop_bound_at(disassembly, header_pc: int) -> Optional[int]:
+    """Statically proven header-arrival bound for the natural loop at
+    `header_pc`, or None (no proof / no verdict). Counted when a bound
+    is handed out (``absint.loop_bounds_applied``)."""
+    result = absint_for(disassembly)
+    if result is None:
+        return None
+    bound = result.loop_bound(header_pc)
+    if bound is not None:
+        metrics.inc("absint.loop_bounds_applied")
+    return bound
+
+
+def merge_mem_windows(disassembly, join_pc: int):
+    """Non-overlapping 32-byte window start offsets covering the proven
+    diamond write regions at `join_pc`, or None (untracked join / screen
+    off). The frontier ships these to the widened merge phase."""
+    result = absint_for(disassembly)
+    if result is None:
+        return None
+    return result.word_windows(join_pc)
+
+
+#: ops that write the memory plane — a join's window fact stops
+#: bounding NEW divergence past the block's first such instruction
+_MEM_WRITERS = frozenset({
+    "MSTORE", "MSTORE8", "CALLDATACOPY", "CODECOPY", "EXTCODECOPY",
+    "RETURNDATACOPY", "MCOPY", "CALL", "CALLCODE", "DELEGATECALL",
+    "STATICCALL"})
+
+
+def merge_window_pcs(disassembly, join_pc: int) -> Tuple[int, ...]:
+    """Every pc of the join block where the join's window fact still
+    bounds any arm-divergent memory bytes: from `join_pc` through the
+    block's first memory-writing instruction (inclusive — a lane
+    sitting ON the writer has not executed it yet).
+
+    The widened merge phase is eligibility-gated on the lane pc at pass
+    time, and the merge cadence can land a chunk after the lanes step
+    off the join — shipping a row per covered pc keeps the reconverged
+    pair mergeable anywhere in the join block. Rows past a memory write
+    would merely fail the kernel's diff-containment check (missed
+    blend, never a wrong one), but they carry no signal, so stop."""
+    cfa = cfa_for(disassembly)
+    block = cfa.block_at(join_pc) if cfa is not None else None
+    if block is None:
+        return (join_pc,)
+    info = cfa.blocks[block]
+    pcs = []
+    for ins in disassembly.instruction_list[
+            info.first_index:info.last_index + 1]:
+        if ins.address < join_pc:
+            continue
+        pcs.append(ins.address)
+        if ins.op_code in _MEM_WRITERS:
+            break
+    return tuple(pcs) or (join_pc,)
 
 
 def block_key(disassembly, pc: int) -> int:

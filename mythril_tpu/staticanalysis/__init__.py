@@ -13,19 +13,24 @@ in which case callers keep their dynamic paths.
 On top of the cfa tables, :mod:`.taint` + :mod:`.summary` add a
 source->sink taint dataflow, selector/function partitioning, and
 natural-loop hint tables; :func:`get_summary` is the memoized entry
-point with the same None-means-no-verdict contract.
+point with the same None-means-no-verdict contract. :mod:`.absint`
+adds the value-range / memory-region abstract interpretation (interval
+stack cells, diamond write regions, proven loop bounds, constant-JUMPI
+verdicts) behind :func:`get_absint`, same contract again.
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
+from .absint import AbsintResult, build_absint
 from .cfa import BasicBlock, CfaResult, TERMINATORS, build_cfa
 from .domtree import compute_idoms, dominator_depth, postorder
 from .summary import ContractSummary, FunctionInfo, LoopInfo, build_summary
 from .taint import SinkSite, TaintResult, build_taint
 
 __all__ = [
+    "AbsintResult",
     "BasicBlock",
     "CfaResult",
     "ContractSummary",
@@ -34,11 +39,13 @@ __all__ = [
     "SinkSite",
     "TERMINATORS",
     "TaintResult",
+    "build_absint",
     "build_cfa",
     "build_summary",
     "build_taint",
     "compute_idoms",
     "dominator_depth",
+    "get_absint",
     "get_cfa",
     "get_summary",
     "install_summary",
@@ -135,3 +142,52 @@ def install_summary(disassembly, summary: Optional[ContractSummary]) -> None:
     """Pre-seed the summary memo (serve warm path: summaries persisted by
     code hash skip the rebuild on repeat contracts)."""
     disassembly._taint_summary = summary
+
+
+def get_absint(disassembly) -> Optional[AbsintResult]:
+    """Build (once) and return the value-range/memory-region tables for
+    a Disassembly.
+
+    Memoized on the Disassembly instance (`_absint_result`), like
+    :func:`get_cfa`. Returns None when MYTHRIL_TPU_ABSINT is off, the
+    cfa tables are unavailable, or the fixpoint bailed — consumers
+    treat None as "no verdict" and keep their dynamic paths.
+    """
+    import time
+
+    from ..observe import metrics, trace
+    from ..support import tpu_config
+
+    cached = getattr(disassembly, "_absint_result", _MISS)
+    if cached is not _MISS:
+        return cached
+
+    if not tpu_config.get_flag("MYTHRIL_TPU_ABSINT"):
+        disassembly._absint_result = None
+        return None
+
+    cfa = get_cfa(disassembly)
+    if cfa is None:
+        disassembly._absint_result = None
+        return None
+
+    with trace.span("absint.build") as span:
+        start = time.perf_counter()
+        result = build_absint(disassembly, cfa)
+        if result is None:
+            span.set(bailed=True)
+        else:
+            span.set(
+                blocks=len(result.entry_intervals),
+                widenings=result.widenings,
+                regions=result.regions_proven,
+                loop_bounds=len(result.loop_bounds),
+                const_jumpis=len(result.const_jumpis),
+            )
+            metrics.observe(
+                "absint.build_ms",
+                (time.perf_counter() - start) * 1000.0)
+            metrics.inc("absint.widenings", result.widenings)
+            metrics.inc("absint.regions_proven", result.regions_proven)
+    disassembly._absint_result = result
+    return result

@@ -52,6 +52,11 @@ class Args:
         #: analysis/module_screen.py); --no-taint turns all consumers
         #: off for A/B measurement
         self.taint = True
+        #: value-range / memory-region abstract interpretation
+        #: (staticanalysis/absint.py): widened memory-plane merging,
+        #: proven loop bounds, constant-JUMPI pruning; --no-absint turns
+        #: all consumers off for A/B measurement
+        self.absint = True
         #: device-resident frontier counter plane (parallel/symstep.py);
         #: --no-frontier-telemetry compiles it out for A/B measurement
         self.frontier_telemetry = True

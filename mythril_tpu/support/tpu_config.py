@@ -287,6 +287,22 @@ _KNOBS: List[Knob] = [
          "Concrete storage slots tracked per contract by the taint "
          "dataflow; writes past the budget (or to unknown slots) collapse "
          "into one conservative summary cell."),
+    # -- value-range / memory-region absint (staticanalysis/absint.py) ------------
+    Knob("MYTHRIL_TPU_ABSINT", "flag", True,
+         "Build per-contract value-range + memory write-region tables "
+         "(stride-interval fixpoint over the CFA with loop-header "
+         "widening) and let consumers blend diverged memory planes at "
+         "proven join regions, apply proven loop bounds, and prune "
+         "constant JUMPI sides; the --no-absint CLI flag also turns the "
+         "consumers off for A/B runs."),
+    Knob("MYTHRIL_TPU_ABSINT_MAX_ITERS", "int", 64,
+         "Header-arrival cap for the absint loop trip-count prover; "
+         "loops that do not provably exit within this many abstract "
+         "iterations keep the flat unroll default."),
+    Knob("MYTHRIL_TPU_ABSINT_MEM_REGIONS", "int", 8,
+         "32-byte memory windows tracked per join point by the widened "
+         "merge phase; joins whose proven write regions need more "
+         "windows stay on the identical-memory gate."),
     # -- test corpora -------------------------------------------------------------
     Knob("MYTHRIL_TPU_VMTESTS", "str", None,
          "Root of the ethereum/tests VMTests corpus for parity suites."),

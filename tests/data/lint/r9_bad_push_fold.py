@@ -1,0 +1,19 @@
+"""R9 fixture: folds PUSH immediates locally instead of reading the
+absint tables."""
+
+
+def resolve_constant_target(instruction_list, index):
+    push = instruction_list[index]
+    # (1) attribute-style immediate fold
+    return int(push.argument, 16)
+
+
+def fold_selector(instruction):
+    # (2) dict-style immediate fold
+    return int(instruction["argument"], 16) >> 224
+
+
+class Interval:  # (3) ad-hoc interval domain class
+    def __init__(self, lo, hi):
+        self.lo = lo
+        self.hi = hi

@@ -1,0 +1,15 @@
+"""R9 fixture: statically re-simulates stack heights from the opcode
+table instead of reading the CFA's entry_height."""
+
+from mythril_tpu.ops import opcodes
+
+
+def simulate_heights(instruction_list):
+    height = 0
+    heights = []
+    for ins in instruction_list:
+        heights.append(height)
+        _, pops, pushes, _ = opcodes.opcodes[ins.op_code]
+        # the flagged idiom: arithmetic over pushes/pops
+        height = height - pops + pushes
+    return heights

@@ -1,0 +1,21 @@
+"""R9 fixture: consumes the shared absint verdicts; generic hex
+parsing stays legal."""
+
+
+def load_bytecode(text: str) -> bytes:
+    # generic hex parse without an instruction `argument` is fine
+    return bytes.fromhex(text) if text else b""
+
+
+def parse_address(text: str) -> int:
+    return int(text, 16)
+
+
+def screen_branch(code, jumpi_pc):
+    from mythril_tpu.smt.solver import cfa_screen
+
+    # the blessed path: read the memoized value-range verdicts
+    verdict = cfa_screen.jumpi_verdict(code, jumpi_pc)
+    if verdict is not None:
+        return verdict
+    return cfa_screen.loop_bound_at(code, jumpi_pc)

@@ -1,0 +1,634 @@
+"""Value-range + memory-region abstract interpretation
+(``staticanalysis/absint.py``) and its consumers:
+
+* soundness: a concrete differential reference on random branchy
+  programs — every concrete stack cell observed at a block entry must
+  lie inside the computed stride-interval, and every concrete memory
+  write must land inside the block's proven write region (``None`` =
+  ⊤ claims nothing and is always sound);
+* widening: an unbounded counting loop must still converge, with the
+  header interval absorbing every concrete counter value;
+* the consumer surface: proven loop trip bounds
+  (``cfa_screen.loop_bound_at`` -> ``core/strategy/bounded_loops.py``),
+  constant-JUMPI verdicts, join write regions and their 32-byte merge
+  windows (``parallel/frontier.py`` -> ``symstep.merge_pass``);
+* the knobs: ``MYTHRIL_TPU_ABSINT`` / ``_MAX_ITERS`` / ``_MEM_REGIONS``
+  gate the pass exactly as the README table declares;
+* the device kernel: a diamond whose arms both MSTORE different words
+  at offset 0 is blocked by the identical-memory gate (counted in
+  ``frontier.merge.blocked_by.memory``) and merged by the widened
+  phase when the static window table unlocks it — with byte-identical
+  detections either way (the ``--no-absint`` A/B contract).
+"""
+
+import os
+import random
+import sys
+
+import pytest
+
+os.environ.setdefault("MYTHRIL_TPU_LANES", "16")
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mythril_tpu.frontends.asm import assemble  # noqa: E402
+from mythril_tpu.frontends.disassembler import Disassembly  # noqa: E402
+from mythril_tpu.staticanalysis import (build_absint,  # noqa: E402
+                                        build_cfa, get_absint)
+from mythril_tpu.staticanalysis.absint import (AbsintResult,  # noqa: E402
+                                               contains)
+
+_WORD = (1 << 256) - 1
+
+
+def _build(asm_source):
+    disassembly = Disassembly(assemble(asm_source).hex())
+    cfa = build_cfa(disassembly)
+    assert cfa is not None
+    result = build_absint(disassembly, cfa)
+    assert result is not None
+    return disassembly, cfa, result
+
+
+# -- the concrete differential reference ---------------------------------------------
+#
+# Random two-armed diamonds over a modeled opcode subset. A concrete
+# run picks one arm per calldata seed; the fixpoint must cover BOTH.
+# Any concrete stack cell outside its interval, or any concrete write
+# outside its region, is a domain-transfer bug.
+
+_BINARY = {
+    "ADD": lambda a, b: (a + b) & _WORD,
+    "SUB": lambda a, b: (a - b) & _WORD,
+    "MUL": lambda a, b: (a * b) & _WORD,
+    "AND": lambda a, b: a & b,
+    "OR": lambda a, b: a | b,
+    "XOR": lambda a, b: a ^ b,
+}
+
+
+def _random_arm(rng):
+    """Stack-valid random straight-line op list (op, push arg)."""
+    ops = []
+    depth = 0
+    for _ in range(rng.randint(4, 12)):
+        pool = ["PUSH1"]
+        if depth >= 1:
+            pool += ["CALLDATALOAD", "DUP1", "STORE8"]
+        if depth >= 2:
+            pool += list(_BINARY) + ["DUP2", "SWAP1", "STORE"]
+        if depth >= 3:
+            pool += ["POP", "WILDSTORE"]
+        op = rng.choice(pool)
+        if op == "STORE":
+            # constant-offset MSTORE of whatever is on the stack —
+            # the bounded-region path
+            ops.append(("PUSH1", rng.choice((0, 32, 64, 96))))
+            ops.append(("MSTORE", None))
+            depth -= 1
+        elif op == "STORE8":
+            ops.append(("PUSH1", rng.randint(0, 127)))
+            ops.append(("MSTORE8", None))
+            depth -= 1
+        elif op == "WILDSTORE":
+            # data-dependent offset: the pass must go ⊤, not guess
+            ops.append(("MSTORE", None))
+            depth -= 2
+        else:
+            ops.append((op, rng.randint(0, 255) if op == "PUSH1"
+                        else None))
+            if op in ("PUSH1", "CALLDATALOAD", "DUP1", "DUP2"):
+                depth += 1 if op != "CALLDATALOAD" else 0
+            elif op in _BINARY or op == "POP":
+                depth -= 1
+    return ops
+
+
+def _render(ops):
+    return "\n".join(f"PUSH1 {arg:#04x}" if op == "PUSH1" else op
+                     for op, arg in ops)
+
+
+def _random_program(rng):
+    """A two-armed diamond around random arm bodies."""
+    return (
+        "PUSH1 0x00\nCALLDATALOAD\nPUSH @odd\nJUMPI\n"
+        + _render(_random_arm(rng))
+        + "\nPUSH @join\nJUMP\nodd:\nJUMPDEST\n"
+        + _render(_random_arm(rng))
+        + "\njoin:\nJUMPDEST\nSTOP\n")
+
+
+def _calldata(seed, offset):
+    return (seed * 1000003 + offset * 7919 + 11) & _WORD
+
+
+def _imm(instruction):
+    return int(instruction.argument, 16)
+
+
+def _run_concrete(disassembly, cfa, seed, max_steps=4096):
+    """Concretely execute the contract; returns
+
+    * ``entries`` — (block id, stack snapshot bottom->top) at every
+      block-entry arrival,
+    * ``writes`` — (block id, offset, size) per memory write.
+    """
+    by_address = {ins.address: i
+                  for i, ins in enumerate(disassembly.instruction_list)}
+    stack, entries, writes = [], [], []
+    index = 0
+    for _ in range(max_steps):
+        ins = disassembly.instruction_list[index]
+        block_id = cfa.block_at(ins.address)
+        if block_id is not None \
+                and cfa.blocks[block_id].start_pc == ins.address:
+            entries.append((block_id, tuple(stack)))
+        op = ins.op_code
+        if op == "STOP":
+            return entries, writes
+        if op.startswith("PUSH"):
+            stack.append(_imm(ins))
+        elif op == "CALLDATALOAD":
+            stack.append(_calldata(seed, stack.pop()))
+        elif op in _BINARY:
+            a, b = stack.pop(), stack.pop()
+            stack.append(_BINARY[op](a, b))
+        elif op == "DUP1":
+            stack.append(stack[-1])
+        elif op == "DUP2":
+            stack.append(stack[-2])
+        elif op == "SWAP1":
+            stack[-1], stack[-2] = stack[-2], stack[-1]
+        elif op == "POP":
+            stack.pop()
+        elif op == "MSTORE":
+            offset, _value = stack.pop(), stack.pop()
+            writes.append((block_id, offset, 32))
+        elif op == "MSTORE8":
+            offset, _value = stack.pop(), stack.pop()
+            writes.append((block_id, offset, 1))
+        elif op == "JUMP":
+            index = by_address[stack.pop()]
+            continue
+        elif op == "JUMPI":
+            dest, cond = stack.pop(), stack.pop()
+            if cond:
+                index = by_address[dest]
+                continue
+        elif op == "JUMPDEST":
+            pass
+        else:
+            raise AssertionError(f"unmodeled op {op}")
+        index += 1
+    raise AssertionError("concrete run did not terminate")
+
+
+def _assert_entry_sound(result, block_id, stack):
+    assert block_id in result.entry_intervals, \
+        f"block {block_id} reached concretely but not abstractly"
+    height, vals = result.entry_intervals[block_id]
+    if height is not None:
+        assert len(stack) == height, \
+            f"block {block_id}: concrete height {len(stack)} != " \
+            f"abstract {height}"
+        assert len(stack) >= len(vals)
+    for cell in range(min(len(vals), len(stack))):
+        iv, value = vals[-1 - cell], stack[-1 - cell]
+        assert contains(iv, value), \
+            f"block {block_id} cell -{cell + 1}: {value:#x} not in {iv}"
+
+
+def _assert_write_sound(result, block_id, offset, size):
+    regions = result.block_writes.get(block_id)
+    if regions is None:
+        return  # ⊤: no claim
+    assert any(start <= offset and offset + size <= end
+               for start, end in regions), \
+        f"block {block_id}: write [{offset}, {offset + size}) " \
+        f"outside proven {regions}"
+
+
+def test_random_programs_intervals_are_sound():
+    rng = random.Random(0xab51)
+    for trial in range(40):
+        disassembly, cfa, result = _build(_random_program(rng))
+        for seed in (rng.getrandbits(64), rng.getrandbits(64) | 1):
+            entries, writes = _run_concrete(disassembly, cfa, seed)
+            assert entries, "no block entry observed"
+            for block_id, stack in entries:
+                _assert_entry_sound(result, block_id, stack)
+            for block_id, offset, size in writes:
+                _assert_write_sound(result, block_id, offset, size)
+
+
+# -- widening / loop bounds ----------------------------------------------------------
+
+#: i = 0; i += 1 forever — only widening terminates the fixpoint
+UNBOUNDED_LOOP = """
+PUSH1 0x00
+head:
+JUMPDEST
+PUSH1 0x01
+ADD
+PUSH @head
+JUMP
+"""
+
+#: i = 0; while i != 5: i += 1 — five iterations, six header arrivals
+COUNTING_LOOP = """
+PUSH1 0x00
+head:
+JUMPDEST
+DUP1
+PUSH1 0x05
+EQ
+PUSH @exit
+JUMPI
+PUSH1 0x01
+ADD
+PUSH @head
+JUMP
+exit:
+JUMPDEST
+POP
+STOP
+"""
+
+
+def _header_pc(disassembly):
+    for ins in disassembly.instruction_list:
+        if ins.op_code == "JUMPDEST":
+            return ins.address
+    raise AssertionError("no loop header JUMPDEST")
+
+
+def test_widening_converges_on_unbounded_loop():
+    disassembly, cfa, result = _build(UNBOUNDED_LOOP)
+    assert result.widenings >= 1
+    assert result.iterations < 256  # far under the bail cap
+    header_block = cfa.block_at(_header_pc(disassembly))
+    _height, vals = result.entry_intervals[header_block]
+    counter = vals[-1]
+    # the widened interval absorbs every concrete counter value
+    for value in (0, 1, 2, 1000, 10 ** 9):
+        assert contains(counter, value)
+
+
+def test_counting_loop_bound_is_proven():
+    disassembly, _cfa, result = _build(COUNTING_LOOP)
+    header = _header_pc(disassembly)
+    assert result.loop_bounds == {header: 6}
+    assert result.loop_bound(header) == 6
+    assert result.loop_bound(header + 1) is None
+
+
+def test_loop_bound_consumer_via_cfa_screen():
+    from mythril_tpu.smt.solver import cfa_screen
+
+    disassembly = Disassembly(assemble(COUNTING_LOOP).hex())
+    header = _header_pc(disassembly)
+    assert cfa_screen.loop_bound_at(disassembly, header) == 6
+
+
+# -- constant-JUMPI verdicts ---------------------------------------------------------
+
+ALWAYS_TAKEN = """
+PUSH1 0x01
+PUSH @live
+JUMPI
+PUSH1 0x00
+PUSH1 0x00
+REVERT
+live:
+JUMPDEST
+STOP
+"""
+
+NEVER_TAKEN = """
+PUSH1 0x00
+PUSH @dead
+JUMPI
+STOP
+dead:
+JUMPDEST
+PUSH1 0x00
+PUSH1 0x00
+REVERT
+"""
+
+
+def _jumpi_pc(disassembly):
+    return next(ins.address for ins in disassembly.instruction_list
+                if ins.op_code == "JUMPI")
+
+
+def test_const_jumpi_verdicts():
+    disassembly, _cfa, result = _build(ALWAYS_TAKEN)
+    assert result.jumpi_verdict(_jumpi_pc(disassembly)) is True
+
+    disassembly, _cfa, result = _build(NEVER_TAKEN)
+    assert result.jumpi_verdict(_jumpi_pc(disassembly)) is False
+    # no claim at a non-JUMPI pc
+    assert result.jumpi_verdict(0) is None
+
+
+# -- join regions and the 32-byte merge windows --------------------------------------
+
+#: both diamond arms MSTORE a different word at offset 0 and push the
+#: same stack value before the join
+DIAMOND_ASM = """
+PUSH1 0x00
+CALLDATALOAD
+PUSH @odd
+JUMPI
+PUSH1 0x07
+PUSH1 0x00
+MSTORE
+PUSH1 0x05
+PUSH @join
+JUMP
+odd:
+JUMPDEST
+PUSH1 0x09
+PUSH1 0x00
+MSTORE
+PUSH1 0x05
+join:
+JUMPDEST
+POP
+STOP
+"""
+
+
+def test_diamond_join_region_and_windows():
+    disassembly, cfa, result = _build(DIAMOND_ASM)
+    assert cfa.branch_merge_pc, "diamond join not recovered"
+    join_pc = next(iter(cfa.branch_merge_pc.values()))
+    assert result.join_regions[join_pc] == ((0, 32),)
+    assert result.word_windows(join_pc) == (0,)
+    assert result.word_windows(join_pc + 1) is None  # untracked pc
+    assert result.regions_proven == 1
+
+
+def _windows_only(join_regions, cap=8):
+    return AbsintResult(
+        code_length=0, entry_intervals={}, block_writes={},
+        join_regions=join_regions, loop_bounds={}, const_jumpis={},
+        mem_regions_cap=cap)
+
+
+def test_word_windows_never_overlap():
+    # nearby regions must share one cursor: naive per-region rounding
+    # would emit overlapping windows and break the kernel's
+    # diff-containment equality
+    result = _windows_only({7: ((0, 8), (16, 40))})
+    assert result.word_windows(7) == (0, 32)
+    result = _windows_only({7: ((4, 40),)})
+    assert result.word_windows(7) == (4, 36)
+
+
+def test_word_windows_cap_is_top():
+    spread = tuple((64 * k, 64 * k + 8) for k in range(12))
+    assert _windows_only({7: spread}, cap=8).word_windows(7) is None
+    assert _windows_only({7: spread}, cap=16).word_windows(7) == \
+        tuple(64 * k for k in range(12))
+
+
+# -- persistence ---------------------------------------------------------------------
+
+def test_json_roundtrip():
+    _disassembly, cfa, result = _build(DIAMOND_ASM)
+    join_pc = next(iter(cfa.branch_merge_pc.values()))
+    clone = AbsintResult.from_json(result.to_json())
+    assert clone is not None
+    assert clone.entry_intervals == result.entry_intervals
+    assert clone.block_writes == result.block_writes
+    assert clone.join_regions == result.join_regions
+    assert clone.loop_bounds == result.loop_bounds
+    assert clone.const_jumpis == result.const_jumpis
+    assert clone.word_windows(join_pc) == result.word_windows(join_pc)
+
+
+def test_from_json_rejects_malformed_documents():
+    assert AbsintResult.from_json(None) is None
+    assert AbsintResult.from_json([]) is None
+    assert AbsintResult.from_json({"version": -1}) is None
+
+
+# -- the env knobs -------------------------------------------------------------------
+
+def test_absint_flag_gates_the_pass(monkeypatch):
+    from mythril_tpu.smt.solver import cfa_screen
+
+    monkeypatch.setenv("MYTHRIL_TPU_ABSINT", "0")
+    assert not cfa_screen.absint_enabled()
+    disassembly = Disassembly(assemble(DIAMOND_ASM).hex())
+    assert get_absint(disassembly) is None
+    assert cfa_screen.jumpi_verdict(disassembly, 0) is None
+    assert cfa_screen.merge_mem_windows(disassembly, 0) is None
+
+
+def test_max_iters_knob_limits_loop_proofs(monkeypatch):
+    # a 6-arrival loop cannot be proven with a 2-arrival budget
+    monkeypatch.setenv("MYTHRIL_TPU_ABSINT_MAX_ITERS", "2")
+    disassembly = Disassembly(assemble(COUNTING_LOOP).hex())
+    result = build_absint(disassembly)
+    assert result is not None
+    assert result.loop_bounds == {}
+
+
+def test_mem_regions_knob_caps_the_windows(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_ABSINT_MEM_REGIONS", "1")
+    disassembly = Disassembly(assemble(DIAMOND_ASM).hex())
+    result = build_absint(disassembly)
+    assert result is not None
+    assert result.mem_regions_cap == 1
+
+
+# -- the device kernel: widened memory-plane merging ---------------------------------
+
+#: the both-arms-write diamond: JUMPI forks at pc 5; the fall arm
+#: MSTOREs 7 at offset 0, the taken arm MSTOREs 9 — both push 5 and
+#: reach the join JUMPDEST@25 after six steps (padding equalizes the
+#: arms), then spin 25 -> 26 -> 28 -> 25 staying RUNNING forever.
+#: Stacks and msize agree at the join; ONLY memory bytes differ.
+DIAMOND_BOTHWRITE = bytes.fromhex(
+    "6000" "35"           # 0: PUSH1 0; CALLDATALOAD   (symbolic word)
+    "6010" "57"           # 3: PUSH1 16; JUMPI         (fork)
+    "6007" "6000" "52"    # 6: PUSH1 7; PUSH1 0; MSTORE   (fall arm)
+    "6005" "6019" "56"    # 11: PUSH1 5; PUSH1 25; JUMP
+    "5b" "6009"           # 16: JUMPDEST; PUSH1 9      (taken arm)
+    "6000" "52"           # 19: PUSH1 0; MSTORE
+    "6005"                # 22: PUSH1 5
+    "5b"                  # 24: JUMPDEST               (padding)
+    "5b" "6019" "56")     # 25: JUMPDEST; PUSH1 25; JUMP (join + spin)
+
+STOP_ONLY = bytes.fromhex("00")
+
+
+def _bothwrite_run(n_steps=13):
+    import numpy as np
+
+    from mythril_tpu.parallel import arena as parena
+    from mythril_tpu.parallel import batch as pbatch
+    from mythril_tpu.parallel import symstep
+
+    specs = [pbatch.LaneSpec(DIAMOND_BOTHWRITE, gas_limit=2 ** 40),
+             pbatch.LaneSpec(STOP_ONLY, gas_limit=2 ** 40)]
+    state = pbatch.build_batch(specs, stack_slots=16, memory_bytes=128,
+                               calldata_bytes=64, retdata_bytes=32,
+                               storage_slots=8, tstore_slots=2)
+    planes = symstep.SymPlanes.empty(2, 16, 128, 8, max_conds=8)
+    arena = parena.new_arena(capacity=1 << 10, const_capacity=1 << 6)
+    sched = symstep.new_scheduler(state, planes, 4, 4)
+    state, planes, arena, sched = symstep.run_chunk(
+        state, planes, arena, sched, n_steps)
+    assert (np.asarray(state.status) == symstep.RUNNING).sum() == 2
+    np.testing.assert_array_equal(np.asarray(state.pc), [25, 25])
+    return state, planes, arena
+
+
+def _const_word(arena, node):
+    import numpy as np
+
+    from mythril_tpu.parallel import arena as parena
+
+    assert int(np.asarray(arena.op)[node]) == parena.CONST
+    limbs = np.asarray(arena.const_vals)[int(np.asarray(arena.imm)[node])]
+    return sum(int(limb) << (16 * i) for i, limb in enumerate(limbs))
+
+
+def _native_cdcl():
+    from mythril_tpu.smt.solver import sat
+
+    return sat.have_native()
+
+
+def test_identical_memory_gate_blocks_and_counts():
+    """Without a window table the pair must NOT merge, and the
+    blocked-by accounting must attribute the refusal to memory."""
+    pytest.importorskip("jax")
+    import numpy as np
+
+    from mythril_tpu.parallel import symstep
+
+    state, planes, arena = _bothwrite_run()
+    state, planes, arena, stats = symstep.merge_pass(
+        state, planes, arena, np.asarray([25], dtype=np.int32),
+        n_rounds=2)
+    stats = np.asarray(stats)
+    assert int(stats[0]) == 0                  # no merge
+    blocked = dict(zip(symstep.MERGE_BLOCKED_LABELS, stats[3:8]))
+    assert int(blocked["memory"]) == 1
+    assert int(blocked["mem_sym"]) == 0
+    assert (np.asarray(state.status) == symstep.RUNNING).sum() == 2
+
+
+def test_window_table_unlocks_the_memory_blend():
+    """The static window [0, 32) proves the divergence is containable:
+    the widened phase must merge the pair, retiring one lane and
+    rewriting the survivor's word as a clean per-byte ITE reference."""
+    pytest.importorskip("jax")
+    import numpy as np
+
+    from mythril_tpu.parallel import symstep
+
+    state, planes, arena = _bothwrite_run()
+    state, planes, arena, stats = symstep.merge_pass(
+        state, planes, arena, np.asarray([25], dtype=np.int32),
+        mem_pcs=np.asarray([25], dtype=np.int32),
+        mem_words=np.asarray([[0]], dtype=np.int32), n_rounds=2)
+    stats = np.asarray(stats)
+    assert int(stats[0]) == 1                  # merged
+    assert int(stats[2]) == 1                  # one memory blend
+    st = np.asarray(state.status)
+    assert (st == symstep.RUNNING).sum() == 1
+    assert (st == symstep.DEAD).sum() == 1
+    survivor = int(np.argmax(st == symstep.RUNNING))
+    # path condition popped: (P & c) | (P & ~c) = P
+    assert int(np.asarray(planes.cond_count)[survivor]) == 0
+    # the blended word: every byte cell points at ONE ITE node, in the
+    # symbolic MSTORE's clean (node << 5) + j encoding
+    cells = np.asarray(planes.mem_sym)[survivor, 0:32]
+    first = int(cells[0])
+    assert first > 0 and first % 32 == 0
+    np.testing.assert_array_equal(cells,
+                                  first + np.arange(32, dtype=cells.dtype))
+    ite = first >> 5
+    assert int(np.asarray(arena.op)[ite]) == 0x0F
+    assert _const_word(arena, int(np.asarray(arena.b)[ite])) == 9
+    assert _const_word(arena, int(np.asarray(arena.c)[ite])) == 7
+
+
+# -- the full A/B contract: --no-absint is invisible to the detectors ----------------
+
+#: branchy veritesting contract whose arms BOTH write memory: the
+#: identical-memory gate blocks the join without absint, the widened
+#: phase merges it with absint — detections must match either way
+BRANCHY_MEM = {
+    "boom()":
+        "PUSH1 0x00\nCALLDATALOAD\nPUSH1 0x01\nAND\n"
+        "PUSH @odd\nJUMPI\n"
+        "PUSH1 0x07\nPUSH1 0x00\nMSTORE\nPUSH1 0x05\nPUSH @join\nJUMP\n"
+        "odd:\nJUMPDEST\nPUSH1 0x09\nPUSH1 0x00\nMSTORE\nPUSH1 0x05\n"
+        "JUMPDEST\n"
+        "join:\nJUMPDEST\nPUSH1 0x00\nSSTORE\nJUMPDEST\n"
+        "CALLER\nSELFDESTRUCT",
+}
+
+
+def _analyze_branchy_mem(absint_on, monkeypatch):
+    from mythril_tpu.analysis.security import (fire_lasers,
+                                               reset_callback_modules)
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.frontends.asm import creation_wrapper, dispatcher
+    from mythril_tpu.observe import metrics
+
+    if not absint_on:
+        monkeypatch.setenv("MYTHRIL_TPU_ABSINT", "0")
+    monkeypatch.setenv("MYTHRIL_TPU_CHUNK", "1")
+    metrics.reset("frontier.merge")
+    metrics.reset("absint")
+    reset_callback_modules()
+    creation = creation_wrapper(assemble(dispatcher(BRANCHY_MEM)))
+    wrapper = SymExecWrapper(
+        creation.hex(), address=None, strategy="bfs", max_depth=128,
+        execution_timeout=240, create_timeout=30, transaction_count=1,
+        modules=["AccidentallyKillable"], compulsory_statespace=False,
+        engine="tpu")
+    issues = fire_lasers(wrapper, white_list=["AccidentallyKillable"])
+    detections = sorted(
+        (issue.swc_id, issue.address, issue.function,
+         [step.get("input") for step in
+          issue.transaction_sequence["steps"]])
+        for issue in issues)
+    return detections, metrics.snapshot()
+
+
+def test_absint_ab_detections_identical(monkeypatch):
+    """The tentpole acceptance: with absint the widened phase merges a
+    memory-diverged pair the identical-memory gate blocks, and the
+    detectors cannot tell the difference. Witness calldata is compared
+    by selector (the merged path's weaker disjunction may pick another
+    valid model for the unconstrained branch word)."""
+    pytest.importorskip("jax")
+    if not _native_cdcl():
+        pytest.skip("native CDCL build required")
+
+    with_absint, snap_on = _analyze_branchy_mem(True, monkeypatch)
+    without, snap_off = _analyze_branchy_mem(False, monkeypatch)
+
+    def norm(detections):
+        return [(swc, addr, fn, [step[:10] for step in steps])
+                for swc, addr, fn, steps in detections]
+
+    assert norm(with_absint) == norm(without)
+    assert [d[0] for d in with_absint] == ["106"]
+    # absint on: the widened phase actually blended a memory plane
+    assert snap_on.get("absint.merge.mem_blends", 0) >= 1
+    assert snap_on.get("frontier.merge.events", 0) >= 1
+    # absint off: the same join was blocked by the memory gate
+    assert snap_off.get("absint.merge.mem_blends", 0) == 0
+    assert snap_off.get("frontier.merge.blocked_by.memory", 0) >= 1

@@ -28,12 +28,12 @@ if REPO_ROOT not in sys.path:
 
 from tools.lint import (Baseline, LintContext, LintRule,  # noqa: E402
                         RuleDiscovery, Violation, run_lint)
-from tools.lint.rules import (dispatch_bypass, env_knobs,  # noqa: E402
-                              hook_parity, jump_resolution,
+from tools.lint.rules import (abstract_domains, dispatch_bypass,  # noqa: E402
+                              env_knobs, hook_parity, jump_resolution,
                               metrics_registry, opcode_semantics,
                               silent_excepts, trace_safety)
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9")
 
 
 def _tree(text, filename="<fixture>"):
@@ -104,7 +104,7 @@ def test_discovery_build_and_subset():
     subset = discovery.get_rules(["R5", "R1"])
     assert [rule.code for rule in subset] == ["R5", "R1"]
     with pytest.raises(KeyError):
-        discovery.get_rules(["R9"])
+        discovery.get_rules(["R10"])
 
 
 def test_discovery_is_singleton():
@@ -150,6 +150,10 @@ def _r8(name):
                                   hook_parity.load_opcode_names())
 
 
+def _r9(name):
+    return abstract_domains.check_file(name, _fixture_tree(name))
+
+
 @pytest.mark.parametrize("runner,fixture,expected_sites", [
     (_r1, "r1_bad_silent_pass.py", {"drain"}),
     (_r1, "r1_bad_bare_continue.py", {"poll", "<module>"}),
@@ -178,6 +182,9 @@ def _r8(name):
     (_r8, "r8_bad_missing_sinks.py",
      {"NoSinkTable:taint-sinks", "StaleSinkTable:DELEGATECALL",
       "StaleSinkTable:CALL:value"}),
+    (_r9, "r9_bad_push_fold.py",
+     {"push-fold", "push-fold#1", "domain:Interval"}),
+    (_r9, "r9_bad_stack_sim.py", {"stack-sim"}),
 ])
 def test_bad_fixture_fires(runner, fixture, expected_sites):
     violations = runner(fixture)
@@ -196,6 +203,7 @@ def test_bad_fixture_fires(runner, fixture, expected_sites):
     (_r6, "r6_clean.py"),
     (_r7, "r7_clean.py"),
     (_r8, "r8_clean.py"),
+    (_r9, "r9_clean.py"),
 ])
 def test_clean_fixture_is_quiet(runner, fixture):
     assert runner(fixture) == []

@@ -1,0 +1,187 @@
+"""Value-range/memory-region absint smoke for the pre-merge gate
+(tools/check.sh).
+
+Stdlib + in-repo frontends only (no jax import, no symbolic
+execution), so it runs in a couple of seconds:
+
+1. build the absint tables for both vendored headline contracts
+   (killbilly, bectoken) and require a converged fixpoint with
+   non-empty entry intervals and at least one bounded block write
+   region;
+2. on a hand-assembled diamond whose arms both MSTORE offset 0,
+   require the join region [0, 32) to be proven and exactly one
+   32-byte merge window derived — the static fact behind the widened
+   memory-plane merge (parallel/symstep.py merge_pass);
+3. on a hand-assembled counting loop, require the proven
+   header-arrival bound (core/strategy/bounded_loops.py consumer);
+4. on a constant-condition branch, require the JUMPI verdict
+   (smt/solver/cfa_screen.py jumpi_verdict consumer);
+5. require the MYTHRIL_TPU_ABSINT=0 gate to disable the memoized
+   accessor (the --no-absint A/B contract).
+
+Prints ``ABSINT_SMOKE=ok`` on success; any failure exits non-zero
+with a diagnostic.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: diamond on a calldata word: both arms MSTORE a different constant
+#: at offset 0 and push the same stack value before the join
+DIAMOND = """
+PUSH1 0x00
+CALLDATALOAD
+PUSH @odd
+JUMPI
+PUSH1 0x07
+PUSH1 0x00
+MSTORE
+PUSH1 0x05
+PUSH @join
+JUMP
+odd:
+JUMPDEST
+PUSH1 0x09
+PUSH1 0x00
+MSTORE
+PUSH1 0x05
+join:
+JUMPDEST
+POP
+STOP
+"""
+
+#: i = 0; while i != 5: i += 1 — five iterations, six header arrivals
+LOOP = """
+PUSH1 0x00
+head:
+JUMPDEST
+DUP1
+PUSH1 0x05
+EQ
+PUSH @exit
+JUMPI
+PUSH1 0x01
+ADD
+PUSH @head
+JUMP
+exit:
+JUMPDEST
+POP
+STOP
+"""
+
+#: JUMPI on a provably-true condition (PUSH1 1)
+CONST_BRANCH = """
+PUSH1 0x01
+PUSH @live
+JUMPI
+PUSH1 0x00
+PUSH1 0x00
+REVERT
+live:
+JUMPDEST
+STOP
+"""
+
+
+def _build(asm: str):
+    from mythril_tpu.frontends.asm import assemble
+    from mythril_tpu.frontends.disassembler import Disassembly
+    from mythril_tpu.staticanalysis import build_absint, build_cfa
+
+    disassembly = Disassembly(assemble(asm).hex())
+    cfa = build_cfa(disassembly)
+    if cfa is None:
+        return None, None
+    return build_absint(disassembly, cfa), cfa
+
+
+def main() -> int:
+    from mythril_tpu.frontends.asm import assemble, dispatcher
+    from mythril_tpu.frontends.disassembler import Disassembly
+    from mythril_tpu.staticanalysis import build_absint, get_absint
+    from tools.measure_headline import BECTOKEN, KILLBILLY
+
+    # 1) vendored corpus: converged tables with bounded write regions
+    for name, spec in (("killbilly", KILLBILLY), ("bectoken", BECTOKEN)):
+        disassembly = Disassembly(assemble(dispatcher(spec)).hex())
+        result = build_absint(disassembly)
+        if result is None:
+            print(f"absint_smoke: fixpoint bailed on {name}",
+                  file=sys.stderr)
+            return 1
+        if not result.entry_intervals:
+            print(f"absint_smoke: no entry intervals for {name}",
+                  file=sys.stderr)
+            return 1
+        bounded = [regions for regions in result.block_writes.values()
+                   if regions]
+        if not bounded:
+            print(f"absint_smoke: no bounded write region on {name}",
+                  file=sys.stderr)
+            return 1
+
+    # 2) diamond: proven join region + exactly one 32-byte window
+    result, cfa = _build(DIAMOND)
+    if result is None:
+        print("absint_smoke: diamond fixpoint bailed", file=sys.stderr)
+        return 1
+    if not cfa.branch_merge_pc:
+        print("absint_smoke: diamond has no recovered join",
+              file=sys.stderr)
+        return 1
+    join_pc = next(iter(cfa.branch_merge_pc.values()))
+    regions = result.join_regions.get(join_pc)
+    if regions != ((0, 32),):
+        print(f"absint_smoke: diamond join region {regions!r}, "
+              "want ((0, 32),)", file=sys.stderr)
+        return 1
+    if result.word_windows(join_pc) != (0,):
+        print(f"absint_smoke: diamond windows "
+              f"{result.word_windows(join_pc)!r}, want (0,)",
+              file=sys.stderr)
+        return 1
+
+    # 3) counting loop: proven header-arrival bound (5 iters -> 6)
+    result, _ = _build(LOOP)
+    if result is None or not result.loop_bounds:
+        print("absint_smoke: loop bound not proven", file=sys.stderr)
+        return 1
+    bound = next(iter(result.loop_bounds.values()))
+    if bound != 6:
+        print(f"absint_smoke: loop bound {bound}, want 6",
+              file=sys.stderr)
+        return 1
+
+    # 4) constant branch: static always-taken verdict
+    result, _ = _build(CONST_BRANCH)
+    if result is None or True not in result.const_jumpis.values():
+        print("absint_smoke: constant JUMPI not proven", file=sys.stderr)
+        return 1
+
+    # 5) the A/B gate: MYTHRIL_TPU_ABSINT=0 disables the accessor
+    disassembly = Disassembly(assemble(CONST_BRANCH).hex())
+    old = os.environ.get("MYTHRIL_TPU_ABSINT")
+    os.environ["MYTHRIL_TPU_ABSINT"] = "0"
+    try:
+        if get_absint(disassembly) is not None:
+            print("absint_smoke: MYTHRIL_TPU_ABSINT=0 did not gate "
+                  "get_absint", file=sys.stderr)
+            return 1
+    finally:
+        if old is None:
+            os.environ.pop("MYTHRIL_TPU_ABSINT", None)
+        else:
+            os.environ["MYTHRIL_TPU_ABSINT"] = old
+
+    print("ABSINT_SMOKE=ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

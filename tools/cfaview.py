@@ -17,8 +17,14 @@ height, post-dominator merge pc), resolved/unresolved jump sites, branch
 merge points, and statically-dead code regions. ``--taint`` appends the
 source->sink taint summary: recovered public functions (selectors),
 natural loops, per-sink operand taint verdicts, and the detection
-modules the module screen would skip wholesale. ``--json`` dumps the
-raw tables instead (with a ``taint`` key under ``--taint``).
+modules the module screen would skip wholesale. ``--absint`` appends
+the value-range/memory-region verdict (staticanalysis/absint.py):
+per-block entry stride-intervals, per-block write regions, join-point
+memory windows (what the widened merge phase ships to the device),
+statically proven loop trip bounds, and provably-constant JUMPIs.
+``--json`` dumps the raw tables instead (with ``taint`` / ``absint``
+keys under the matching flags; the ``absint`` document round-trips
+through ``AbsintResult.from_json``).
 
 Host-only (the cfa pass is stdlib + in-repo frontends; no jax import).
 Exit codes: 0 on success, 2 when the input is missing/undecodable or the
@@ -213,6 +219,93 @@ def taint_report(summary, disassembly) -> str:
     return "\n".join(lines)
 
 
+def _iv_str(iv) -> str:
+    """Compact stride-interval rendering: constants as hex, TOP as T,
+    everything else as [lo..hi /stride]."""
+    from mythril_tpu.staticanalysis.absint import TOP
+
+    lo, hi, stride = iv
+    if iv == TOP:
+        return "T"
+    if stride == 0:
+        return f"{lo:#x}"
+    return f"[{lo:#x}..{hi:#x} /{stride}]"
+
+
+def absint_report(absint, cfa) -> str:
+    lines: List[str] = []
+    lines.append("")
+    lines.append("== absint: summary ==")
+    lines.append(f"  fixpoint: {absint.iterations} iteration(s), "
+                 f"{absint.widenings} widening(s), "
+                 f"{len(absint.entry_intervals)} block(s) tracked")
+    lines.append(f"  proven: {absint.regions_proven} join region(s), "
+                 f"{len(absint.loop_bounds)} loop bound(s), "
+                 f"{len(absint.const_jumpis)} constant JUMPI(s)")
+
+    lines.append("")
+    lines.append("== absint: block entry ranges (top -> deep) ==")
+    for block_id in sorted(absint.entry_intervals):
+        height, cells = absint.entry_intervals[block_id]
+        start_pc = cfa.blocks[block_id].start_pc
+        if height is None:
+            lines.append(f"  B{block_id:<3} {start_pc:#6x}  h=?  (unknown "
+                         "entry — unresolved-jump fan-in)")
+            continue
+        stack = "  ".join(_iv_str(iv) for iv in cells) or "-"
+        lines.append(f"  B{block_id:<3} {start_pc:#6x}  h={height:<3} {stack}")
+
+    lines.append("")
+    lines.append("== absint: block write regions ==")
+    any_write = False
+    for block_id in sorted(absint.block_writes):
+        regions = absint.block_writes[block_id]
+        if regions == ():
+            continue
+        any_write = True
+        start_pc = cfa.blocks[block_id].start_pc
+        body = "TOP (unbounded/symbolic offset)" if regions is None else \
+            " ".join(f"[{a:#x},{b:#x})" for a, b in regions)
+        lines.append(f"  B{block_id:<3} {start_pc:#6x}  {body}")
+    if not any_write:
+        lines.append("  (no block writes memory)")
+
+    lines.append("")
+    lines.append("== absint: join-point memory windows ==")
+    if absint.join_regions:
+        for pc in sorted(absint.join_regions):
+            regions = absint.join_regions[pc]
+            windows = absint.word_windows(pc)
+            body = " ".join(f"[{a:#x},{b:#x})" for a, b in regions) or \
+                "(no writes on either arm)"
+            wtxt = ("windows " + " ".join(f"{w:#x}" for w in windows)
+                    if windows else
+                    "no windows needed" if windows == () else
+                    "over the window cap — widened merge skipped")
+            lines.append(f"  join {pc:#6x}: {body}  -> {wtxt}")
+    else:
+        lines.append("  (no diamond proves a bounded write region)")
+
+    lines.append("")
+    lines.append("== absint: proven loop bounds (header arrivals) ==")
+    if absint.loop_bounds:
+        for pc in sorted(absint.loop_bounds):
+            lines.append(f"  header {pc:#6x} -> {absint.loop_bounds[pc]}")
+    else:
+        lines.append("  (no loop trip count proven)")
+
+    lines.append("")
+    lines.append("== absint: constant JUMPIs ==")
+    if absint.const_jumpis:
+        for pc in sorted(absint.const_jumpis):
+            verdict = ("always taken" if absint.const_jumpis[pc]
+                       else "never taken")
+            lines.append(f"  {pc:#6x} -> {verdict}")
+    else:
+        lines.append("  (no provably-constant branch)")
+    return "\n".join(lines)
+
+
 def as_json(result) -> dict:
     """The dense tables, JSON-serializable (dict keys become strings)."""
     return {
@@ -251,6 +344,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="append the source->sink taint summary "
                              "(functions, loops, sink verdicts, module "
                              "screen)")
+    parser.add_argument("--absint", action="store_true",
+                        help="append the value-range/memory-region "
+                             "verdict (per-block entry intervals, write "
+                             "regions, join windows, proven loop bounds, "
+                             "constant JUMPIs)")
     args = parser.parse_args(argv)
     try:
         bytecode = load_bytecode(args.contract)
@@ -278,6 +376,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "via MYTHRIL_TPU_TAINT=0, or the fixpoint bailed)",
                   file=sys.stderr)
             return 2
+    absint = None
+    if args.absint:
+        from mythril_tpu.staticanalysis import build_absint
+
+        absint = build_absint(disassembly, result)
+        if absint is None:
+            print("cfaview: absint verdict unavailable (the fixpoint "
+                  "bailed — iteration budget)", file=sys.stderr)
+            return 2
     if args.json:
         import json
         doc = as_json(result)
@@ -285,11 +392,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             doc["taint"] = summary.to_json()
             doc["taint"]["screened_modules"] = \
                 _screened_module_names(disassembly)
+        if absint is not None:
+            doc["absint"] = absint.to_json()
         print(json.dumps(doc, indent=2))
     else:
         text = report(result, disassembly.instruction_list)
         if summary is not None:
             text += "\n" + taint_report(summary, disassembly)
+        if absint is not None:
+            text += "\n" + absint_report(absint, result)
         print(text)
     return 0
 

@@ -25,6 +25,10 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu \
     python -m tools.taint_smoke || exit $?
 
 echo
+echo "== absint smoke (value ranges + join windows + loop bounds, jax-free) =="
+timeout -k 10 120 python -m tools.absint_smoke || exit $?
+
+echo
 echo "== frontierview smoke (jax-free counter-track report) =="
 timeout -k 10 60 python -m tools.frontierview \
     tests/data/trace/frontier_trace.json > /dev/null || exit $?

@@ -29,7 +29,10 @@ With ``--metrics`` it also summarizes an fsync-atomic metrics snapshot
 (``analyze --metrics-out`` / ``MYTHRIL_TPU_METRICS`` /
 ``observe.metrics.write_snapshot``): the ``frontier.telemetry.*``
 counters, gauges, and labeled histograms, plus the
-``frontier.merge.*`` slice — merges per join-point tag, lanes
+``frontier.merge.*`` slice — merges per join-point tag, the
+``blocked_by.*`` gate breakdown (which equality gate refused
+reconverged-looking pairs; memory rows are what absint join windows
+unblock), lanes
 retired, and the ITE-depth (blended-slots-per-pair) histogram.
 
 Stdlib-only (json/argparse): usable on a workstation without jax.
@@ -325,10 +328,35 @@ def _metrics_slice(snapshot: Dict[str, object], prefix: str,
     return lines
 
 
+def _blocked_by_section(snapshot: Dict[str, object]) -> List[str]:
+    """Rank the frontier.merge.blocked_by.* gate counters: which
+    equality gate refused reconverged-looking pairs. A memory-dominated
+    profile is the absint signal — proven join windows
+    (MYTHRIL_TPU_ABSINT) unblock exactly that gate; mem_sym / tstore /
+    depth rows need deeper representation work, not wider windows."""
+    prefix = "frontier.merge.blocked_by."
+    rows = {str(name)[len(prefix):]: value
+            for name, value in snapshot.items()
+            if str(name).startswith(prefix)
+            and isinstance(value, (int, float))}
+    lines = ["== merge blocked-by gates =="]
+    if not rows:
+        lines.append("  (no blocked pairs recorded — every "
+                     "reconverged-looking pair merged, or no merge "
+                     "passes ran)")
+        return lines
+    total = sum(rows.values()) or 1
+    for gate, count in sorted(rows.items(), key=lambda kv: -kv[1]):
+        share = count / total
+        bar = "#" * max(1, int(round(share * 24)))
+        lines.append(f"  {gate:<14} {count:>10.0f}  {share:>5.1%}  {bar}")
+    return lines
+
+
 def metrics_report(snapshot: Dict[str, object]) -> str:
     """Summarize the frontier.telemetry.* and frontier.merge.* slices of
     a metrics snapshot (observe.metrics.write_snapshot /
-    --metrics-out)."""
+    --metrics-out), including the blocked-by gate breakdown."""
     lines = [""]
     lines.extend(_metrics_slice(
         snapshot, "frontier.telemetry.",
@@ -338,6 +366,8 @@ def metrics_report(snapshot: Dict[str, object]) -> str:
         snapshot, "frontier.merge.",
         "no merge passes ran — state merging off or no reconverged "
         "lanes"))
+    lines.append("")
+    lines.extend(_blocked_by_section(snapshot))
     lines.append("")
     lines.extend(_metrics_slice(
         snapshot, "serve.worker.",

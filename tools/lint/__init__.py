@@ -34,6 +34,10 @@ Rules (see ``tools/lint/rules/``):
   must name declared opcodes (``ops/opcodes.py``), and hooked modules
   must declare a ``taint_sinks`` table consistent with their hook lists
   (the taint module screen's skip contract).
+* **R9 abstract-domains** — value-range / stack-shape static reasoning
+  (PUSH-immediate folds, stack-height simulation, ad-hoc interval
+  domains) belongs to ``mythril_tpu/staticanalysis/``; consumers read
+  the absint verdicts through ``smt/solver/cfa_screen.py``.
 
 Run ``python -m tools.lint`` (exit 1 on violations), or via the tier-1
 suite (tests/test_lint.py). Known, audited violations live in

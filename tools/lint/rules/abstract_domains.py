@@ -1,0 +1,146 @@
+"""R9 — abstract-domain ownership: value-range / stack-shape static
+reasoning belongs to ``mythril_tpu/staticanalysis/``.
+
+``staticanalysis/cfa.py`` (the baselined producer) and
+``staticanalysis/absint.py`` already simulate abstract stacks, fold
+PUSH immediates, and run stride-interval arithmetic once per contract;
+consumers read the memoized verdicts through
+``smt/solver/cfa_screen.py`` (``jumpi_verdict``, ``loop_bound_at``,
+``merge_mem_windows``) exactly like R7's jump tables. A module that
+re-folds PUSH constants or re-simulates stack heights forks that
+domain: its copy silently diverges the moment the shared pass learns a
+refinement (new transfer function, tighter widening), and the absint
+A/B counters stop describing the run.
+
+Flagged outside ``mythril_tpu/staticanalysis/``:
+
+* a PUSH-immediate fold — ``int(X, 16)`` where ``X`` mentions an
+  ``argument`` name/attribute/key (the disassembly instruction-dict
+  idiom; generic hex parsing without ``argument`` is fine);
+* stack-height simulation — arithmetic combining ``pushes`` and
+  ``pops`` operands (re-deriving stack effects instead of reading the
+  CFA's ``entry_height`` / ``block_key`` tables);
+* an ad-hoc interval domain — a class or function named like an
+  abstract domain (``Interval``, ``StrideInterval``, ``ValueRange``,
+  ``make_interval``, ``join_iv``, ``widen_iv``, ``interval_binary``).
+
+The legitimate non-static owners carry justified baseline entries: the
+disassembler (produces the instruction stream the folds read), the
+host PUSH handler and the device lockstep interpreter (they *execute*
+immediates and stack effects rather than statically simulating them).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import LintContext, LintRule, Violation
+
+SCAN_DIRS = ("mythril_tpu", "tools", "tests", "bench.py")
+ALLOWED_PREFIX = "mythril_tpu/staticanalysis/"
+
+IMMEDIATE_NAME = "argument"
+DOMAIN_NAMES = ("Interval", "StrideInterval", "ValueRange",
+                "make_interval", "join_iv", "widen_iv",
+                "interval_binary")
+STACK_EFFECT_NAMES = ("pushes", "pops")
+
+
+def _mentions_name(node: ast.AST, name: str) -> bool:
+    """`name` appears under `node` as a Name, an Attribute, or a
+    constant subscript/string key."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == name:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == name:
+            return True
+        if isinstance(sub, ast.Constant) and sub.value == name:
+            return True
+    return False
+
+
+def _is_base16_int(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "int"
+            and len(node.args) == 2
+            and isinstance(node.args[1], ast.Constant)
+            and node.args[1].value == 16)
+
+
+def check_file(relpath: str, tree: ast.AST) -> List[Violation]:
+    violations: List[Violation] = []
+
+    seen_tags: dict = {}
+
+    def flag(lineno: int, how: str, tag: str) -> None:
+        # stable, line-free keys: same-kind repeats get an ordinal suffix
+        # (walk order is deterministic for a given file)
+        ordinal = seen_tags.get(tag, 0)
+        seen_tags[tag] = ordinal + 1
+        if ordinal:
+            tag = f"{tag}#{ordinal}"
+        violations.append(Violation(
+            "R9", relpath, lineno,
+            f"{how} re-implements abstract-domain reasoning — consume "
+            "the shared value-range tables instead "
+            "(staticanalysis.get_absint / smt/solver/cfa_screen.py: "
+            "jumpi_verdict, loop_bound_at, merge_mem_windows)",
+            where=tag, key=f"R9:{relpath}:{tag}"))
+
+    for node in ast.walk(tree):
+        if _is_base16_int(node) \
+                and _mentions_name(node.args[0], IMMEDIATE_NAME):
+            flag(node.lineno,
+                 "`int(..., 16)` over an instruction `argument` "
+                 "(PUSH-immediate fold)", "push-fold")
+        elif isinstance(node, ast.BinOp):
+            # pushes/pops combined arithmetically = stack-effect
+            # simulation; skip nested BinOps so one expression tree
+            # yields one violation (the outermost match wins)
+            if _mentions_name(node.left, STACK_EFFECT_NAMES[0]) \
+                    and _mentions_name(node, STACK_EFFECT_NAMES[1]) \
+                    or _mentions_name(node.left, STACK_EFFECT_NAMES[1]) \
+                    and _mentions_name(node, STACK_EFFECT_NAMES[0]):
+                flag(node.lineno,
+                     "arithmetic over `pushes`/`pops` (stack-height "
+                     "simulation)", "stack-sim")
+        elif isinstance(node, (ast.ClassDef, ast.FunctionDef,
+                               ast.AsyncFunctionDef)):
+            if node.name in DOMAIN_NAMES:
+                kind = "class" if isinstance(node, ast.ClassDef) \
+                    else "function"
+                flag(node.lineno,
+                     f"{kind} `{node.name}` (ad-hoc interval domain)",
+                     f"domain:{node.name}")
+    return violations
+
+
+class AbstractDomainsRule(LintRule):
+    code = "R9"
+    name = "abstract-domains"
+    description = ("value-range / stack-shape static reasoning (PUSH "
+                   "folds, stack-height simulation, interval "
+                   "arithmetic) belongs to staticanalysis/ — consumers "
+                   "read the absint verdicts via "
+                   "smt/solver/cfa_screen.py")
+
+    def run(self, ctx: LintContext) -> List[Violation]:
+        violations: List[Violation] = []
+        for path in ctx.iter_py(*SCAN_DIRS):
+            relpath = ctx.relpath(path)
+            if relpath.startswith(ALLOWED_PREFIX) \
+                    or relpath.startswith("tools/lint/") \
+                    or relpath == "tools/check_excepts.py" \
+                    or relpath.startswith("tests/data/lint/"):
+                continue
+            violations.extend(check_file(relpath, ctx.tree(path)))
+        return violations
+
+    def check_paths(self, ctx: LintContext, paths) -> List[Violation]:
+        violations: List[Violation] = []
+        for path in paths:
+            violations.extend(
+                check_file(ctx.relpath(path), ctx.tree(path)))
+        return violations
