@@ -299,6 +299,126 @@ def _solver_latency():
             for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"))}
 
 
+def _superopt_contract(n_blocks: int = 12) -> str:
+    """Strength-reduction-rich runtime for the superopt A/B: one stack
+    word, then n_blocks jump-linked blocks each multiplying by a
+    distinct power-of-two constant. Every block's ``PUSH 2^k; MUL ->
+    PUSH k; SHL`` candidate survives the term-IR constant folder, so
+    the proof pass holds n_blocks REAL equivalence queries — deep
+    enough for one batched dispatch flush to amortize against
+    n_blocks sequential host solves."""
+    lines = ["PUSH1 0x00", "CALLDATALOAD"]
+    for i in range(n_blocks):
+        lines += [f"PUSH @b{i}", "JUMP",
+                  f"b{i}:", "JUMPDEST",
+                  f"PUSH2 {hex(1 << (i % 14 + 1))}", "MUL"]
+    lines.append("STOP")
+    return "\n".join(lines)
+
+
+def _superopt_ab(backend):
+    """Gas-superoptimizer proof-discharge A/B (README "Gas
+    superoptimization"): the same strength-reduction-rich contract
+    optimized twice — ``solver=jax`` (every equivalence obligation
+    submitted to the batched dispatch queue: ONE flush, shared verdict
+    cache, UNKNOWNs down the breaker-gated ladder to the host CDCL) vs
+    ``solver=cdcl`` (one sequential host solve per obligation). Parity
+    of the rewritten bytecode is the hard gate; proof wall-clock
+    speedup is the headline on a real accelerator (BASELINE round-8
+    policy: asserted TPU-only — on CPU the device SAT lane is capped
+    out so the phase reports query counts and flush occupancy, which
+    must still show the whole batch shipping in one flush)."""
+    from mythril_tpu.frontends.asm import assemble
+    from mythril_tpu.observe import metrics
+    from mythril_tpu.smt.solver.solver import reset_solver_backend
+    from mythril_tpu.superopt import optimize_bytecode
+
+    code = assemble(_superopt_contract()).hex()
+    saved_env = {key: os.environ.get(key)
+                 for key in ("MYTHRIL_TPU_BATCH_FLUSH",
+                             "MYTHRIL_TPU_BATCH_AGE_MS",
+                             "MYTHRIL_TPU_DEVICE_CLAUSE_CAP")}
+    # one deep flush: the whole obligation batch ships together instead
+    # of dribbling out at the default threshold; the age flush would
+    # shred it the same way it would shred the fleet prefetch union
+    os.environ["MYTHRIL_TPU_BATCH_FLUSH"] = "64"
+    os.environ["MYTHRIL_TPU_BATCH_AGE_MS"] = "60000"
+    if backend == "cpu":
+        # no device: cap the device SAT lane out so submissions still
+        # account (occupancy, flush counts) and fall down the ladder
+        # instantly instead of grinding a host-emulated device solve
+        os.environ["MYTHRIL_TPU_DEVICE_CLAUSE_CAP"] = "1"
+    try:
+        # warm-up: compile-or-cache-load the solver buckets off-clock
+        reset_solver_backend()
+        optimize_bytecode(code, solver="jax")
+        # measured batched run: warm executables, cold verdict cache
+        reset_solver_backend()
+        metrics.reset("superopt")
+        start = time.perf_counter()
+        batched = optimize_bytecode(code, solver="jax")
+        batched_wall = time.perf_counter() - start
+        hist = metrics.histogram("superopt.proof_flush.occupancy")
+        occupancy = (round(hist.total / hist.count, 2)
+                     if hist and hist.count else 0.0)
+        # sequential side: same contract, host CDCL per obligation
+        reset_solver_backend()
+        start = time.perf_counter()
+        sequential = optimize_bytecode(code, solver="cdcl")
+        seq_wall = time.perf_counter() - start
+    finally:
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+    result = {
+        "blocks": batched.blocks_scanned,
+        "rewrites": len(sequential.rewrites),
+        "gas_saved": sequential.gas_saved,
+        "parity": batched.code_out == sequential.code_out,
+        "batched": {"wall_s": round(batched_wall, 3),
+                    "mean_flush_occupancy": occupancy,
+                    "proof_stats": dict(batched.proof_stats)},
+        "sequential": {"wall_s": round(seq_wall, 3),
+                       "proof_stats": dict(sequential.proof_stats)},
+        "proof_speedup": round(seq_wall / max(batched_wall, 1e-9), 2),
+    }
+    assert result["parity"], (
+        "superopt A/B emitted different bytecode: batched="
+        f"{batched.code_out} sequential={sequential.code_out}")
+    assert result["rewrites"] >= 8 and result["gas_saved"] > 0, (
+        f"superopt A/B contract under-rewrote: {result}")
+    assert batched.proof_stats["queries"] >= 8, (
+        f"superopt A/B produced too few real queries: {result}")
+    if backend != "cpu":
+        assert result["proof_speedup"] > 1.0, (
+            f"batched proof discharge slower than sequential: {result}")
+    return result
+
+
+def _superopt_ab_main():
+    """``python bench.py superopt_ab``: just the superopt proof A/B —
+    the fast re-run mode for BENCH_r10-style measurements (the full
+    bench also lands the phase in its extras)."""
+    import jax
+
+    backend = jax.devices()[0].platform
+    _phase("devices", backend=backend, n=len(jax.devices()))
+    ab = _superopt_ab(backend)
+    _phase("superopt_ab", proof_speedup=ab["proof_speedup"],
+           parity=ab["parity"],
+           queries=ab["batched"]["proof_stats"]["queries"],
+           mean_flush_occupancy=ab["batched"]["mean_flush_occupancy"])
+    print(json.dumps({
+        "metric": "superopt_proof_speedup",
+        "value": ab["proof_speedup"],
+        "unit": "x",
+        "backend": backend,
+        "superopt_ab": ab,
+    }), flush=True)
+
+
 def _warm_start_ab():
     """Cold-vs-warm worker spawn A/B (README "Durable warmth"): one
     child process seeds a private warmset manifest + executable cache +
@@ -348,6 +468,8 @@ def _warm_start_ab():
 
 
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "superopt_ab":
+        return _superopt_ab_main()
     seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 45.0
     import jax
 
@@ -648,6 +770,18 @@ def main():
         warm_start_ab = {"error": str(error)[:500]}
         _phase("warm_start", error=warm_start_ab["error"])
 
+    # 3e. superopt proof-discharge A/B (README "Gas superoptimization"):
+    #     batched-device vs sequential-host equivalence proving over the
+    #     same rewrite candidates. In-process and deterministic, so its
+    #     parity assertion is a hard gate like the other A/B phases.
+    with trace.span("bench.superopt_ab"):
+        superopt_ab = _superopt_ab(backend)
+    _phase("superopt_ab", proof_speedup=superopt_ab["proof_speedup"],
+           parity=superopt_ab["parity"],
+           queries=superopt_ab["batched"]["proof_stats"]["queries"],
+           mean_flush_occupancy=superopt_ab["batched"]
+                                           ["mean_flush_occupancy"])
+
     if tpu_info["forks_on_device"] > 0 and tpu_rate > host_rate:
         trace.export()
         metrics.write_snapshot(metrics_path)
@@ -666,6 +800,7 @@ def main():
             "merge_mem_ab": merge_mem_ab,
             "fleet_ab": fleet_ab,
         "shard_ab": shard_ab,
+            "superopt_ab": superopt_ab,
             "warm_start": warm_start_ab,
             "frontier": _frontier_rollup(),
         "solver_latency_ms": _solver_latency(),
@@ -701,6 +836,7 @@ def main():
         "merge_mem_ab": merge_mem_ab,
         "fleet_ab": fleet_ab,
         "shard_ab": shard_ab,
+        "superopt_ab": superopt_ab,
         "warm_start": warm_start_ab,
         "frontier": _frontier_rollup(),
         "solver_latency_ms": _solver_latency(),

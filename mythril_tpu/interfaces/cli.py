@@ -256,6 +256,81 @@ def _cmd_analyze(parser, cli_args, safe_functions: bool = False) -> int:
     return 1 if report.issues else 0
 
 
+def _add_optimize_args(parser: argparse.ArgumentParser) -> None:
+    inputs = parser.add_argument_group("input")
+    inputs.add_argument("-c", "--code", help="hex runtime bytecode")
+    inputs.add_argument("-f", "--codefile",
+                        help="file containing hex runtime bytecode")
+
+    options = parser.add_argument_group("options")
+    options.add_argument("--solver", default="cdcl", choices=["cdcl", "jax"],
+                         help="equivalence-proof backend: host CDCL oracle "
+                              "or the batched device dispatch queue (one "
+                              "flush, shared verdict cache, UNKNOWNs fall "
+                              "down the ladder to the host)")
+    options.add_argument("--max-block-len", type=int, default=None,
+                         metavar="N",
+                         help="longest pure-stack block eligible for the "
+                              "exhaustive stack-scheduling search (default: "
+                              "MYTHRIL_TPU_SUPEROPT_MAX_BLOCK_LEN)")
+    options.add_argument("--candidates", type=int, default=None, metavar="N",
+                         help="search-sequence budget per block (default: "
+                              "MYTHRIL_TPU_SUPEROPT_CANDIDATES)")
+    options.add_argument("--crosscheck", type=int, default=None, metavar="N",
+                         help="re-decide every Nth accepted proof on the "
+                              "host CDCL oracle (default: "
+                              "MYTHRIL_TPU_SUPEROPT_CROSSCHECK; 0 = off)")
+
+    output = parser.add_argument_group("output")
+    output.add_argument("-o", "--outform", default="text",
+                        choices=["text", "json"])
+    output.add_argument("--code-out", default=None, metavar="PATH",
+                        help="also write the rewritten runtime bytecode "
+                             "(hex) to PATH")
+
+
+def _cmd_optimize(parser, cli_args) -> int:
+    from ..superopt import optimize_bytecode
+
+    code = cli_args.code
+    if code is None and cli_args.codefile:
+        with open(cli_args.codefile) as handle:
+            code = handle.read().strip()
+    if not code:
+        parser.error("optimize needs -c or -f")
+    report = optimize_bytecode(
+        code, solver=cli_args.solver,
+        max_block_len=cli_args.max_block_len,
+        candidates_budget=cli_args.candidates,
+        crosscheck=cli_args.crosscheck)
+    if cli_args.code_out:
+        with open(cli_args.code_out, "w") as handle:
+            handle.write(report.code_out + "\n")
+    if cli_args.outform == "json":
+        print(json.dumps(report.to_json(), indent=2, sort_keys=True))
+    else:
+        stats = report.proof_stats
+        print(f"blocks scanned:     {report.blocks_scanned}")
+        print(f"candidates proven:  {report.candidates} "
+              f"({stats.get('queries', 0)} SAT queries, "
+              f"{stats.get('syntactic', 0)} syntactic)")
+        print(f"rewrites accepted:  {len(report.rewrites)}")
+        print(f"gas saved:          {report.gas_saved} static, "
+              f"{report.weighted_gas_saved} loop-weighted")
+        for rewrite in report.rewrites:
+            print(f"  pc {rewrite.start_pc:#06x} [{rewrite.rule}] "
+                  f"-{rewrite.gas_saved} gas (x{rewrite.weight}, "
+                  f"{rewrite.proof}): "
+                  f"{'; '.join(rewrite.before)} => "
+                  f"{'; '.join(rewrite.after) or '<elided>'}")
+        if report.note:
+            print(f"note: {report.note}")
+        print(report.code_out)
+    # a crosscheck divergence means an unsound device verdict slipped
+    # through: loud, non-zero, and the rewrite was already rejected
+    return 1 if report.proof_stats.get("divergences") else 0
+
+
 def _add_serve_args(parser: argparse.ArgumentParser) -> None:
     transport = parser.add_argument_group("transport")
     transport.add_argument("--socket", default=None, metavar="PATH",
@@ -377,13 +452,13 @@ def _cmd_client(parser, cli_args) -> int:
     payload = {"op": cli_args.op}
     if cli_args.id is not None:
         payload["id"] = cli_args.id
-    if cli_args.op == "analyze":
+    if cli_args.op in ("analyze", "optimize"):
         code = cli_args.code
         if code is None and cli_args.codefile:
             with open(cli_args.codefile) as handle:
                 code = handle.read().strip()
         if not code:
-            parser.error("client analyze needs -c or -f")
+            parser.error(f"client {cli_args.op} needs -c or -f")
         payload.update(
             code=code, bin_runtime=cli_args.bin_runtime,
             transaction_count=cli_args.transaction_count,
@@ -426,6 +501,12 @@ def main(argv=None) -> int:
     safe = subparsers.add_parser("safe-functions",
                                  help="list functions with no detected issues")
     _add_analysis_args(safe)
+
+    optimize = subparsers.add_parser(
+        "optimize", aliases=["opt"],
+        help="gas-superoptimize runtime bytecode: every rewrite backed "
+             "by an equivalence proof (batched device SAT or host CDCL)")
+    _add_optimize_args(optimize)
 
     disasm = subparsers.add_parser("disassemble", aliases=["d"],
                                    help="disassemble EVM bytecode")
@@ -476,7 +557,8 @@ def main(argv=None) -> int:
     client = subparsers.add_parser(
         "client", help="send one request to a running serve daemon")
     client.add_argument("op", nargs="?", default="analyze",
-                        choices=["analyze", "ping", "status", "shutdown"])
+                        choices=["analyze", "optimize", "ping", "status",
+                                 "shutdown"])
     client.add_argument("-c", "--code", help="hex creation bytecode")
     client.add_argument("-f", "--codefile",
                         help="file containing hex bytecode")
@@ -572,6 +654,8 @@ def main(argv=None) -> int:
         return _cmd_client(parser, cli_args)
     if cli_args.command in ("analyze", "a"):
         return _cmd_analyze(parser, cli_args)
+    if cli_args.command in ("optimize", "opt"):
+        return _cmd_optimize(parser, cli_args)
     if cli_args.command == "safe-functions":
         return _cmd_analyze(parser, cli_args, safe_functions=True)
     if cli_args.command in ("foundry", "f"):

@@ -345,6 +345,39 @@ _METRICS: List[MetricSpec] = [
     MetricSpec("absint.loop_bounds_applied", COUNTER, "1",
                "Loop-header budget decisions where a statically proven "
                "trip-count bound replaced the flat loop_bound default."),
+    # -- gas superoptimization (mythril_tpu/superopt/) ----------------------------
+    MetricSpec("superopt.blocks_scanned", COUNTER, "1",
+               "CFA basic blocks walked by the superoptimizer (eligible "
+               "or not)."),
+    MetricSpec("superopt.candidates", COUNTER, "1",
+               "Candidate rewrites that survived screening and became "
+               "equivalence obligations."),
+    MetricSpec("superopt.search_sequences", COUNTER, "1",
+               "Sequences tried by the exhaustive stack-scheduling "
+               "search (bounded by MYTHRIL_TPU_SUPEROPT_CANDIDATES)."),
+    MetricSpec("superopt.proofs_syntactic", COUNTER, "1",
+               "Obligations whose miter constant-folded to FALSE "
+               "(equivalence proven without a SAT query)."),
+    MetricSpec("superopt.proofs_unsat", COUNTER, "1",
+               "Equivalence obligations proven UNSAT (rewrite accepted)."),
+    MetricSpec("superopt.proofs_sat", COUNTER, "1",
+               "Obligations decided SAT (a distinguishing entry state "
+               "exists; rewrite rejected)."),
+    MetricSpec("superopt.proofs_unknown", COUNTER, "1",
+               "Obligations still UNKNOWN after the fallback ladder "
+               "(rewrite conservatively rejected)."),
+    MetricSpec("superopt.gas_saved", COUNTER, "gas",
+               "Static gas saved by accepted rewrites, loop-bound "
+               "weighted where absint proved a trip count."),
+    MetricSpec("superopt.proof_flush.occupancy", HISTOGRAM, "queries",
+               "Equivalence obligations carried per batched proof "
+               "flush through the dispatch queue."),
+    MetricSpec("superopt.crosschecks", COUNTER, "1",
+               "Sampled accepted proofs re-decided on the host CDCL "
+               "oracle (MYTHRIL_TPU_SUPEROPT_CROSSCHECK)."),
+    MetricSpec("superopt.crosscheck_divergence", COUNTER, "1",
+               "Crosschecks where the host oracle disagreed with the "
+               "accepted verdict (must stay zero)."),
     # -- device memory accounting (observe/export.py, sampled at scrape) ---------
     MetricSpec("device.hbm.bytes_in_use", GAUGE, "bytes",
                "Live HBM bytes across visible devices (jax "

@@ -12,7 +12,9 @@ Request shape::
      "deadline_ms": 60000, "solver": "cdcl", "engine": "host",
      "strategy": "bfs"}
 
-Ops: ``analyze`` (the workload), ``ping`` (liveness), ``status`` (warm-set
+Ops: ``analyze`` (the workload), ``optimize`` (gas superoptimization —
+shares analyze's code/solver/deadline/priority validation; replies carry
+the OptimizationReport), ``ping`` (liveness), ``status`` (warm-set
 and metrics introspection), ``healthz`` (liveness + counters rollup),
 ``metrics`` (Prometheus exposition + the snapshot-ring tail; never
 touches the engine lock), ``shutdown`` (drain and exit). Replies echo
@@ -54,7 +56,8 @@ from typing import Dict, Iterator, List, Optional
 #: of hex); 8 MiB leaves room for huge inits while bounding a hostile peer
 MAX_LINE_BYTES = 8 << 20
 
-OPS = ("analyze", "ping", "status", "shutdown", "healthz", "metrics")
+OPS = ("analyze", "optimize", "ping", "status", "shutdown", "healthz",
+       "metrics")
 
 STRATEGIES = ("dfs", "bfs", "naive-random", "weighted-random",
               "beam-search", "pending")
@@ -134,7 +137,7 @@ def parse_request(line) -> Request:
         raise ProtocolError("unknown_op",
                             f"unknown op {op!r}; expected one of {OPS}",
                             request_id)
-    if op != "analyze":
+    if op not in ("analyze", "optimize"):
         return Request(op, request_id, {})
 
     code = doc.get("code")
@@ -200,7 +203,7 @@ def parse_request(line) -> Request:
              f"priority must be one of {PRIORITIES}", request_id)
     params["priority"] = priority
 
-    return Request("analyze", request_id, params)
+    return Request(op, request_id, params)
 
 
 def encode(reply: Dict) -> str:

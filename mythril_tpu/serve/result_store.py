@@ -62,13 +62,17 @@ _CONFIG_FIELDS = ("bin_runtime", "transaction_count", "strategy",
 
 
 def result_key(params: Dict, solver: str = "cdcl", engine: str = "host",
-               strategy: str = "bfs") -> str:
-    """Content address for one analyze request: sha256 over the
-    normalized bytecode hash plus the *effective* analysis config (the
-    daemon defaults applied, so an explicit ``"solver": "cdcl"`` and an
-    omitted solver under a cdcl daemon hash identically)."""
+               strategy: str = "bfs", op: str = "analyze") -> str:
+    """Content address for one request: sha256 over the normalized
+    bytecode hash plus the *effective* analysis config (the daemon
+    defaults applied, so an explicit ``"solver": "cdcl"`` and an omitted
+    solver under a cdcl daemon hash identically). The request ``op`` is
+    part of the key material: an ``analyze`` verdict and an ``optimize``
+    report for the same bytecode are different results and must never
+    answer each other."""
     config = {
         "v": RESULTS_VERSION,
+        "op": op,
         "code": contract_key(params.get("code")),
         "modules": sorted(params.get("modules") or []) or None,
         "bin_runtime": bool(params.get("bin_runtime", False)),

@@ -597,9 +597,9 @@ class AnalysisService:
             self.shutting_down.set()
             return protocol.ok_reply(request.id, shutdown=True,
                                      requests_served=self._requests_done)
-        # analyze: result-store short-circuit, then queued admission,
-        # then execution. The correlation id is minted here, at
-        # admission — a shed reply gets one too, so its log line and
+        # analyze/optimize: result-store short-circuit, then queued
+        # admission, then execution. The correlation id is minted here,
+        # at admission — a shed reply gets one too, so its log line and
         # reply still correlate.
         cid = slog.new_correlation_id()
         params = request.params
@@ -631,6 +631,14 @@ class AnalysisService:
             with slog.correlated(cid):
                 slog.event("serve.admitted", request_id=str(request.id),
                            op=request.op, priority=priority)
+                if request.op == "optimize":
+                    # superopt rides the same admission queue and worker
+                    # pool as analyze but never micro-batches: its own
+                    # proof obligations already share one dispatch flush
+                    if self._supervisor is not None:
+                        return self._optimize(request, cid)
+                    with self._engine_lock:
+                        return self._optimize(request, cid)
                 if self._fleet_batcher is not None and \
                         (params.get("engine") or self.engine) == "tpu":
                     # micro-batching path: the batch LEADER takes the
@@ -655,7 +663,7 @@ class AnalysisService:
             return None
         params = request.params
         key = result_key(params, solver=self.solver, engine=self.engine,
-                         strategy=self.strategy)
+                         strategy=self.strategy, op=request.op)
         payload = self.result_store.get(
             key, contract_hash=contract_key(params.get("code")))
         if payload is None:
@@ -664,7 +672,7 @@ class AnalysisService:
             metrics.inc("serve.requests")
             self._requests_done += 1
             slog.event("serve.reply", request_id=str(request.id),
-                       ok=True, cached=True,
+                       ok=True, cached=True, op=request.op,
                        issues=payload.get("issue_count", 0))
         return protocol.ok_reply(request.id, correlation_id=cid,
                                  cached=True, elapsed_ms=0.0, **payload)
@@ -869,6 +877,94 @@ class AnalysisService:
             "coverage": getattr(report, "coverage", {}) or {},
             "report": json.loads(report.as_json()),
         }
+
+    def _optimize(self, request, cid: str) -> Dict:
+        """The `optimize` op: gas superoptimization of one runtime
+        bytecode, same accounting shell as `_analyze` (trace span,
+        request metrics, result-store put under the op-discriminated
+        key) around `superopt.optimize_bytecode`."""
+        params = request.params
+        started = time.monotonic()
+        with trace.span("serve.request", request_id=str(request.id),
+                        correlation_id=cid, op="optimize") as span:
+            try:
+                payload = self._run_optimize(params)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except QuarantinedContract as error:
+                log.warning("refusing quarantined contract for request "
+                            "%r: %s", request.id, error)
+                metrics.inc("serve.requests")
+                metrics.inc("serve.request_errors")
+                span.set(error="quarantined")
+                slog.event("serve.reply", request_id=str(request.id),
+                           ok=False, error="quarantined")
+                reply = protocol.error_reply(request.id, "quarantined",
+                                             str(error))
+                reply["correlation_id"] = cid
+                return reply
+            except Exception as error:
+                log.exception("optimization failed for request %r",
+                              request.id)
+                metrics.inc("serve.requests")
+                metrics.inc("serve.request_errors")
+                span.set(error=repr(error))
+                slog.event("serve.reply", request_id=str(request.id),
+                           ok=False, error=repr(error))
+                reply = protocol.error_reply(
+                    request.id, "analysis_failed",
+                    f"{type(error).__name__}: {error}")
+                reply["correlation_id"] = cid
+                return reply
+            span.set(rewrites=len(payload.get("rewrites") or ()),
+                     gas_saved=payload.get("gas_saved", 0))
+        elapsed_ms = (time.monotonic() - started) * 1000.0
+        metrics.inc("serve.requests")
+        metrics.observe("serve.request_ms", elapsed_ms)
+        self._requests_done += 1
+        if self.result_store is not None:
+            # keyed with op="optimize": an analyze verdict for the same
+            # bytecode must never answer an optimize request (and vice
+            # versa) — see result_store.result_key
+            self.result_store.put(
+                result_key(params, solver=self.solver,
+                           engine=self.engine, strategy=self.strategy,
+                           op="optimize"),
+                payload, contract_hash=contract_key(params.get("code")))
+        export.record_snapshot(request_id=str(request.id),
+                               correlation_id=cid)
+        slog.event("serve.reply", request_id=str(request.id), ok=True,
+                   op="optimize",
+                   rewrites=len(payload.get("rewrites") or ()),
+                   gas_saved=payload.get("gas_saved", 0),
+                   elapsed_ms=round(elapsed_ms, 3))
+        return protocol.ok_reply(
+            request.id,
+            correlation_id=cid,
+            elapsed_ms=round(elapsed_ms, 3),
+            **payload)
+
+    def _run_optimize(self, params: Dict) -> Dict:
+        """Route one optimize request: worker mode dispatches to a
+        pooled sandbox (death detection, retry, quarantine — same as
+        analyze), otherwise it runs in-process."""
+        if self._supervisor is not None:
+            return self._supervisor.run_job(params,
+                                            cid=slog.correlation_id(),
+                                            kind="optimize")
+        return self._run_optimize_local(params)
+
+    def _run_optimize_local(self, params: Dict) -> Dict:
+        """One in-process superopt run: same per-request isolation reset
+        as analyze (fresh pipeline/breaker state, warm verdict cache and
+        executables), then the engine walk + batched proofs."""
+        from ..smt.solver.solver import reset_solver_backend
+        from ..superopt import optimize_bytecode
+
+        reset_solver_backend(keep_verdicts=True)
+        report = optimize_bytecode(
+            params["code"], solver=params.get("solver") or self.solver)
+        return report.to_json()
 
     def _seed_summary(self, contract) -> None:
         """Pre-seed a persisted taint summary onto the contract's

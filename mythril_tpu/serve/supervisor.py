@@ -465,18 +465,19 @@ class Supervisor:
 
     # -- job execution -----------------------------------------------------------------
 
-    def run_job(self, params: Dict, cid: Optional[str] = None) -> Dict:
-        """Execute one analyze request in a worker, with quarantine
-        admission, retry-once-on-death, and checkpoint resume. Returns
-        the payload dict; raises QuarantinedContract, the typed worker
-        failure after a double death, or WorkerAnalysisError for a
-        clean in-worker exception."""
+    def run_job(self, params: Dict, cid: Optional[str] = None,
+                kind: str = "analyze") -> Dict:
+        """Execute one analyze (or optimize) request in a worker, with
+        quarantine admission, retry-once-on-death, and checkpoint
+        resume. Returns the payload dict; raises QuarantinedContract,
+        the typed worker failure after a double death, or
+        WorkerAnalysisError for a clean in-worker exception."""
         key = quarantine_mod.contract_key(params.get("code"))
         self._check_quarantine(key)
         job_id = next(self._seq)
         checkpoint = request_checkpoint_path(
             self._workdir, f"{key[:12]}-{job_id}")
-        job = {"kind": "analyze", "job_id": job_id, "params": params,
+        job = {"kind": kind, "job_id": job_id, "params": params,
                "cid": cid, "checkpoint": checkpoint}
         try:
             try:

@@ -2,8 +2,9 @@
 
 ``python -m mythril_tpu.serve.worker`` is spawned by the supervisor
 (serve/supervisor.py), pre-warms from the warmset manifest, then loops
-over JSON-lines jobs on stdin — one ``analyze`` (or one fleet
-micro-batch) per job — writing JSON-lines events back on stdout:
+over JSON-lines jobs on stdin — one ``analyze``, one ``optimize``, or
+one fleet micro-batch per job — writing JSON-lines events back on
+stdout:
 
 * ``{"event": "ready", "pid": ..., "warmed": N, "exec_hits": ...,
   "exec_misses": ..., "verdicts_loaded": ...}`` — once, after the
@@ -154,6 +155,16 @@ def _run_analyze(service, job: dict) -> dict:
     return payload
 
 
+def _run_optimize(service, job: dict) -> dict:
+    """One gas-superoptimization job: same ladder downgrade as analyze
+    (a retried job after a device-side death proves on the host CDCL
+    oracle), no checkpoint — superopt runs are short and restartable."""
+    params = dict(job["params"])
+    if job.get("ladder"):
+        params = _ladder_params(params)
+    return service._run_optimize_local(params)
+
+
 def _run_fleet(service, job: dict) -> dict:
     """One fleet micro-batch: reuses the in-process batcher's engine
     body (service._FleetBatcher._run_batch_inner) on supervisor-shipped
@@ -263,6 +274,8 @@ def main(argv=None) -> int:
                 try:
                     if kind == "fleet":
                         payload = _run_fleet(service, job)
+                    elif kind == "optimize":
+                        payload = _run_optimize(service, job)
                     else:
                         payload = _run_analyze(service, job)
                 except (KeyboardInterrupt, SystemExit):

@@ -198,6 +198,20 @@ _BINARY_FOLDS = {"ADD", "SUB", "MUL", "DIV", "AND", "OR", "XOR",
                  "SHL", "SHR", "EQ", "LT", "GT"}
 
 
+def push_immediate(ins) -> Optional[int]:
+    """The concrete immediate of a PUSH instruction (PUSH0 and an empty
+    argument decode to 0), or None when the hex argument is unparsable.
+    The one shared decode site (R9): every consumer outside this package
+    — the superoptimizer's block layout, future peepholes — reads PUSH
+    immediates through here instead of re-implementing the fold."""
+    if ins.op_code == "PUSH0" or not ins.argument:
+        return 0
+    try:
+        return int(ins.argument, 16)
+    except ValueError:
+        return None
+
+
 class _Stack:
     """Mutable abstract stack for simulating one block."""
 
@@ -268,14 +282,7 @@ def _simulate(block: BasicBlock, instructions, entry: _AbsState,
         ins = instructions[index]
         op = ins.op_code
         if op.startswith("PUSH"):
-            if op == "PUSH0":
-                stack.push(0)
-            else:
-                try:
-                    stack.push(int(ins.argument, 16) if ins.argument
-                               else 0)
-                except ValueError:
-                    stack.push(None)
+            stack.push(push_immediate(ins))
         elif op.startswith("DUP"):
             stack.push(stack.peek(int(op[3:]) - 1))
         elif op.startswith("SWAP"):

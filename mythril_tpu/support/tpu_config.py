@@ -303,6 +303,17 @@ _KNOBS: List[Knob] = [
          "32-byte memory windows tracked per join point by the widened "
          "merge phase; joins whose proven write regions need more "
          "windows stay on the identical-memory gate."),
+    # -- gas superoptimization (mythril_tpu/superopt/) ----------------------------
+    Knob("MYTHRIL_TPU_SUPEROPT_MAX_BLOCK_LEN", "int", 8,
+         "Longest pure-stack block body (instructions) eligible for the "
+         "exhaustive stack-scheduling search; longer blocks only get the "
+         "peephole catalog."),
+    Knob("MYTHRIL_TPU_SUPEROPT_CANDIDATES", "int", 256,
+         "Total candidate sequences the exhaustive search may try per "
+         "block before giving up (catalog rewrites are not counted)."),
+    Knob("MYTHRIL_TPU_SUPEROPT_CROSSCHECK", "int", 8,
+         "Re-decide every Nth accepted superopt equivalence proof on the "
+         "host CDCL oracle and count divergences (0 = off)."),
     # -- test corpora -------------------------------------------------------------
     Knob("MYTHRIL_TPU_VMTESTS", "str", None,
          "Root of the ethereum/tests VMTests corpus for parity suites."),

@@ -1,0 +1,21 @@
+"""R10 bad fixture: one of each parity-drift class against the real
+ops/opcodes.py schedule — a mispriced mnemonic (MUL), a declared opcode
+missing from the table (SHL), and a priced name that is not an opcode
+(WARPSPEED)."""
+
+import importlib.util
+import os
+
+_REPO = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+_spec = importlib.util.spec_from_file_location(
+    "_r10_bad_fixture_opcodes",
+    os.path.join(_REPO, "mythril_tpu", "ops", "opcodes.py"))
+_ops = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_ops)
+
+STATIC_GAS = {name: meta[_ops.GAS][0]
+              for name, meta in _ops.OPCODES.items()}
+STATIC_GAS["MUL"] = 4        # price drift: schedule says 5
+del STATIC_GAS["SHL"]        # declared opcode left unpriced
+STATIC_GAS["WARPSPEED"] = 1  # priced, but not an opcode

@@ -1,0 +1,19 @@
+"""R10 clean fixture: a gas table in exact parity with ops/opcodes.py.
+
+Built from the opcode schedule itself (standalone file-path load, no
+package import), so it cannot drift — the rule must stay quiet here.
+"""
+
+import importlib.util
+import os
+
+_REPO = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+_spec = importlib.util.spec_from_file_location(
+    "_r10_fixture_opcodes",
+    os.path.join(_REPO, "mythril_tpu", "ops", "opcodes.py"))
+_ops = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_ops)
+
+STATIC_GAS = {name: meta[_ops.GAS][0]
+              for name, meta in _ops.OPCODES.items()}

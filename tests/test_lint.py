@@ -29,11 +29,13 @@ if REPO_ROOT not in sys.path:
 from tools.lint import (Baseline, LintContext, LintRule,  # noqa: E402
                         RuleDiscovery, Violation, run_lint)
 from tools.lint.rules import (abstract_domains, dispatch_bypass,  # noqa: E402
-                              env_knobs, hook_parity, jump_resolution,
-                              metrics_registry, opcode_semantics,
-                              silent_excepts, trace_safety)
+                              env_knobs, gas_parity, hook_parity,
+                              jump_resolution, metrics_registry,
+                              opcode_semantics, silent_excepts,
+                              trace_safety)
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9")
+# discovery sorts rule codes as strings, so R10 lands between R1 and R2
+ALL_RULES = ("R1", "R10", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9")
 
 
 def _tree(text, filename="<fixture>"):
@@ -104,7 +106,7 @@ def test_discovery_build_and_subset():
     subset = discovery.get_rules(["R5", "R1"])
     assert [rule.code for rule in subset] == ["R5", "R1"]
     with pytest.raises(KeyError):
-        discovery.get_rules(["R10"])
+        discovery.get_rules(["R99"])
 
 
 def test_discovery_is_singleton():
@@ -154,6 +156,11 @@ def _r9(name):
     return abstract_domains.check_file(name, _fixture_tree(name))
 
 
+def _r10(name):
+    return gas_parity.check_gas_file(
+        os.path.join("tests", "data", "lint", name))
+
+
 @pytest.mark.parametrize("runner,fixture,expected_sites", [
     (_r1, "r1_bad_silent_pass.py", {"drain"}),
     (_r1, "r1_bad_bare_continue.py", {"poll", "<module>"}),
@@ -185,6 +192,7 @@ def _r9(name):
     (_r9, "r9_bad_push_fold.py",
      {"push-fold", "push-fold#1", "domain:Interval"}),
     (_r9, "r9_bad_stack_sim.py", {"stack-sim"}),
+    (_r10, "r10_bad_drift.py", {"MUL", "SHL", "WARPSPEED"}),
 ])
 def test_bad_fixture_fires(runner, fixture, expected_sites):
     violations = runner(fixture)
@@ -204,6 +212,7 @@ def test_bad_fixture_fires(runner, fixture, expected_sites):
     (_r7, "r7_clean.py"),
     (_r8, "r8_clean.py"),
     (_r9, "r9_clean.py"),
+    (_r10, "r10_clean.py"),
 ])
 def test_clean_fixture_is_quiet(runner, fixture):
     assert runner(fixture) == []

@@ -47,6 +47,35 @@ def test_result_key_is_config_sensitive():
     assert result_key({"code": "6001", "modules": ["Suicide"]}) != key
 
 
+def test_result_key_discriminates_op():
+    # an analyze verdict and an optimize report for the same bytecode
+    # are different results: the op is key material, never a collision
+    base = {"code": "6001"}
+    analyze = result_key(base, op="analyze")
+    optimize = result_key(base, op="optimize")
+    assert analyze != optimize
+    # the default op is analyze (pre-optimize sidecars keep hitting)
+    assert result_key(base) == analyze
+    # op discrimination composes with the config axes
+    assert result_key(base, solver="brute", op="optimize") != optimize
+
+
+def test_analyze_then_optimize_same_bytecode_never_collide(tmp_path):
+    # the PR-20 sequence: a daemon analyzes a contract, then gets an
+    # optimize request for the SAME bytecode — the cached analyze
+    # verdict must not answer it, and vice versa
+    store = ResultStore(path=str(tmp_path / "warmset.results.json"))
+    params = {"code": "0x600260020200"}
+    analyze_key = result_key(params, op="analyze")
+    assert store.put(analyze_key, _payload(issues=1))
+    assert store.get(result_key(params, op="optimize")) is None
+    optimize_payload = {"incomplete": False, "code_out": "600400fefefe",
+                        "gas_saved": 8, "rewrites": []}
+    assert store.put(result_key(params, op="optimize"), optimize_payload)
+    assert store.get(analyze_key)["issue_count"] == 1
+    assert store.get(result_key(params, op="optimize"))["gas_saved"] == 8
+
+
 def test_result_key_applies_daemon_defaults():
     # an explicit "solver": "cdcl" and an omitted solver under a cdcl
     # daemon are the same effective config → the same key

@@ -20,6 +20,10 @@ Tracked numbers and their comparability keys:
   (backend, chunk);
 * ``fleet_ab.wall_speedup`` / ``fleet_ab.flush_occupancy_ratio``, keyed
   by (backend, contracts) — the fleet-vs-sequential corpus A/B;
+* ``superopt_ab.proof_speedup`` / ``superopt_ab.flush_occupancy`` from
+  the gas-superoptimizer proof-discharge A/B (``bench.py superopt_ab``),
+  keyed by (backend, queries) — batched-device vs sequential-host
+  equivalence proving over the same rewrite obligations;
 * the ``slo.*`` overload-resilience series from the tools/loadgen.py
   A/B (``interactive_p99_ratio``, ``interactive_served_frac``,
   ``cache_hit_rate``), keyed by (backend, rate_hz) — all fractions
@@ -136,6 +140,24 @@ def extract_points(round_label: str, run: dict) -> List[Point]:
             series = "warm_start.spawn_speedup"
             key = (series, parsed.get("backend"))
             points.append(Point(series, key, round_label, speedup, "x"))
+    superopt = parsed.get("superopt_ab")
+    if isinstance(superopt, dict):
+        batched = superopt.get("batched")
+        batched = batched if isinstance(batched, dict) else {}
+        stats = batched.get("proof_stats")
+        queries = (stats.get("queries")
+                   if isinstance(stats, dict) else None)
+        speedup = _num(superopt.get("proof_speedup"))
+        if speedup is not None:
+            series = "superopt_ab.proof_speedup"
+            key = (series, parsed.get("backend"), queries)
+            points.append(Point(series, key, round_label, speedup, "x"))
+        occupancy = _num(batched.get("mean_flush_occupancy"))
+        if occupancy is not None:
+            series = "superopt_ab.flush_occupancy"
+            key = (series, parsed.get("backend"), queries)
+            points.append(Point(series, key, round_label, occupancy,
+                                "queries/flush"))
     slo = parsed.get("slo")
     if isinstance(slo, dict):
         for field in ("interactive_p99_ratio", "interactive_served_frac",

@@ -29,6 +29,10 @@ echo "== absint smoke (value ranges + join windows + loop bounds, jax-free) =="
 timeout -k 10 120 python -m tools.absint_smoke || exit $?
 
 echo
+echo "== superopt smoke (peephole proof + crosscheck + gas parity, jax-free) =="
+timeout -k 10 120 python -m tools.superopt_smoke || exit $?
+
+echo
 echo "== frontierview smoke (jax-free counter-track report) =="
 timeout -k 10 60 python -m tools.frontierview \
     tests/data/trace/frontier_trace.json > /dev/null || exit $?

@@ -38,6 +38,11 @@ Rules (see ``tools/lint/rules/``):
   (PUSH-immediate folds, stack-height simulation, ad-hoc interval
   domains) belongs to ``mythril_tpu/staticanalysis/``; consumers read
   the absint verdicts through ``smt/solver/cfa_screen.py``.
+* **R10 gas-parity** — the superoptimizer's static gas table
+  (``mythril_tpu/superopt/gas.py``) must stay in parity with the
+  ``ops/opcodes.py`` schedule minimums: equal mnemonic sets, equal
+  floor costs — so rewrite ranking can never drift from the
+  interpreter's gas accounting.
 
 Run ``python -m tools.lint`` (exit 1 on violations), or via the tier-1
 suite (tests/test_lint.py). Known, audited violations live in

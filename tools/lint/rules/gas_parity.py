@@ -1,0 +1,132 @@
+"""R10 — superoptimizer gas-table parity with the opcode schedule.
+
+The superoptimizer ranks proven-equivalent rewrites by static gas saved
+(``mythril_tpu/superopt/gas.py``); the interpreter's authoritative gas
+schedule lives in ``mythril_tpu/ops/opcodes.py`` as each mnemonic's
+``(min, max)`` tuple. If the two drift — an EVM fork bump edits one
+table, a typo prices an opcode wrong, a new mnemonic lands in only one —
+the superoptimizer silently mis-ranks or mis-credits rewrites while
+every equivalence proof still passes. This rule freezes the contract:
+
+* equal mnemonic sets (every declared opcode is priced, nothing extra),
+* ``STATIC_GAS[name] == OPCODES[name][gas][0]`` — the minimum-schedule
+  (warm-access / zero-expansion) floor — for every mnemonic.
+
+The comparison itself is ``gas.parity_errors`` (the same helper
+tests/test_superopt.py calls), so the rule, the unit test, and the cost
+model can never disagree about what parity means. Both modules are
+loaded standalone by file path (the R4 pattern) — stdlib only, never
+drags jax in. In file-scoped mode any explicitly named module that
+defines a top-level ``STATIC_GAS`` is checked as a gas table (the
+fixture hook); files without one are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import re
+from typing import Dict, List, Tuple
+
+from .. import REPO_ROOT, LintContext, LintRule, Violation
+
+GAS_PATH = "mythril_tpu/superopt/gas.py"
+OPCODES_PATH = "mythril_tpu/ops/opcodes.py"
+
+TABLE_NAME = "STATIC_GAS"
+
+#: the three shapes gas.parity_errors emits; used to recover the
+#: offending mnemonic as the violation's stable ``where`` site
+_ERROR_SHAPES = (
+    re.compile(r"^missing from STATIC_GAS: (?P<name>\w+)$"),
+    re.compile(r"^not an opcode: (?P<name>\w+)$"),
+    re.compile(r"^(?P<name>\w+): STATIC_GAS says "),
+)
+
+
+def _load_module(relpath: str, alias: str):
+    """Standalone file-path import (the R4 pattern): no package tree,
+    no jax, no side effects beyond the module's own top level."""
+    path = os.path.join(REPO_ROOT, relpath)
+    spec = importlib.util.spec_from_file_location(alias, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def load_opcode_schedule() -> Tuple[Dict[str, dict], str]:
+    """(OPCODES, gas key) straight from ops/opcodes.py."""
+    module = _load_module(OPCODES_PATH, "_tpu_lint_r10_opcodes")
+    return module.OPCODES, module.GAS
+
+
+def _table_lineno(tree: ast.AST) -> int:
+    """Line of the top-level STATIC_GAS definition (0 when absent)."""
+    for node in getattr(tree, "body", []):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        for target in targets:
+            if isinstance(target, ast.Name) and target.id == TABLE_NAME:
+                return node.lineno
+    return 0
+
+
+def _site(error: str) -> str:
+    for shape in _ERROR_SHAPES:
+        match = shape.match(error)
+        if match:
+            return match.group("name")
+    return "<table>"
+
+
+def check_gas_file(relpath: str, ctx: LintContext = None
+                   ) -> List[Violation]:
+    """Parity violations for one gas-table module — the shipped
+    superopt/gas.py or a fixture defining its own STATIC_GAS — anchored
+    at the table definition line."""
+    ctx = ctx or LintContext()
+    relpath = ctx.relpath(os.path.join(REPO_ROOT, relpath))
+    opcodes, gas_key = load_opcode_schedule()
+    gas = _load_module(GAS_PATH, "_tpu_lint_r10_gas")
+    if relpath == GAS_PATH:
+        table = gas.STATIC_GAS
+    else:
+        alias = "_tpu_lint_r10_target_" + re.sub(r"\W", "_", relpath)
+        table = getattr(_load_module(relpath, alias), TABLE_NAME)
+    lineno = _table_lineno(ctx.tree(os.path.join(REPO_ROOT, relpath)))
+    violations = []
+    for error in gas.parity_errors(opcodes, gas_key, table=table):
+        violations.append(Violation(
+            "R10", relpath, max(lineno, 1),
+            f"gas-table parity with {OPCODES_PATH}: {error} — the "
+            "superoptimizer's rewrite ranking must price exactly the "
+            "declared opcodes at their minimum-schedule cost",
+            where=_site(error)))
+    return violations
+
+
+def _defines_table(tree: ast.AST) -> bool:
+    return _table_lineno(tree) > 0
+
+
+class GasParityRule(LintRule):
+    code = "R10"
+    name = "gas-parity"
+    description = ("the superoptimizer's static gas table "
+                   "(superopt/gas.py) must stay in parity with the "
+                   "ops/opcodes.py schedule minimums: equal mnemonic "
+                   "sets, equal floor costs")
+
+    def run(self, ctx: LintContext) -> List[Violation]:
+        return check_gas_file(GAS_PATH, ctx)
+
+    def check_paths(self, ctx: LintContext, paths) -> List[Violation]:
+        violations: List[Violation] = []
+        for path in paths:
+            if _defines_table(ctx.tree(path)):
+                violations.extend(check_gas_file(ctx.relpath(path), ctx))
+        return violations

@@ -1,0 +1,147 @@
+"""Gas-superoptimizer smoke for the pre-merge gate (tools/check.sh).
+
+Stdlib + in-repo modules only (no jax import — proofs run on the host
+CDCL oracle), so it completes in a couple of seconds:
+
+1. prove the canonical peephole win end to end: a ``PUSH1 0x00; ADD``
+   body behind a jump is elided, the rewritten bytecode keeps its exact
+   length (relocated STOP + INVALID padding), and the report prices the
+   win with the static gas table;
+2. prove a strength reduction (``PUSH1 0x08; MUL`` -> ``PUSH1 0x03;
+   SHL``) whose miter survives the term-IR constant folder — a *real*
+   SAT query — with detection-grade crosscheck at cadence 1: every
+   accepted proof re-decided on the host oracle, zero divergences;
+3. require the MYTHRIL_TPU_SUPEROPT_CROSSCHECK env knob to drive the
+   same cadence through ``support/tpu_config.py``;
+4. require byte-for-byte parity between ``superopt/gas.py`` and the
+   ``ops/opcodes.py`` schedule (the same contract lint rule R10 and
+   tests/test_superopt.py enforce).
+
+Prints ``SUPEROPT_SMOKE=ok`` on success; any failure exits non-zero
+with a diagnostic.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the known peephole win: x + 0 == x, body reached with one stack word
+ELISION = """
+PUSH1 0x00
+CALLDATALOAD
+PUSH @body
+JUMP
+body:
+JUMPDEST
+PUSH1 0x00
+ADD
+STOP
+"""
+
+#: multiply by a constant power of two: the miter does NOT constant-fold
+#: (bvmul by 8 survives the term IR), so the proof is a real SAT query
+STRENGTH = """
+PUSH1 0x00
+CALLDATALOAD
+PUSH @body
+JUMP
+body:
+JUMPDEST
+PUSH1 0x08
+MUL
+STOP
+"""
+
+
+def _optimize(asm: str, **kwargs):
+    from mythril_tpu.frontends.asm import assemble
+    from mythril_tpu.superopt import optimize_bytecode
+
+    return optimize_bytecode(assemble(asm).hex(), **kwargs)
+
+
+def main() -> int:
+    # 1) PUSH1 0 ADD elision, end to end into re-emitted bytecode
+    report = _optimize(ELISION)
+    if len(report.rewrites) != 1:
+        print(f"superopt_smoke: elision got {len(report.rewrites)} "
+              "rewrites, want 1", file=sys.stderr)
+        return 1
+    rewrite = report.rewrites[0]
+    if tuple(rewrite.before) != ("PUSH1 0x0", "ADD") or rewrite.after:
+        print(f"superopt_smoke: elision rewrote {rewrite.before!r} -> "
+              f"{rewrite.after!r}, want full elision", file=sys.stderr)
+        return 1
+    if rewrite.gas_saved != 6 or report.gas_saved != 6:
+        print(f"superopt_smoke: elision saved {report.gas_saved} gas, "
+              "want 6 (PUSH1 3 + ADD 3)", file=sys.stderr)
+        return 1
+    if len(report.code_out) != len(report.code_in):
+        print("superopt_smoke: elision changed the code length",
+              file=sys.stderr)
+        return 1
+    # the body region (PUSH1 00 ADD STOP) must become STOP + INVALID pad
+    if not report.code_out.endswith("5b00fefefe"):
+        print(f"superopt_smoke: elision emitted ...{report.code_out[-10:]}, "
+              "want ...5b00fefefe", file=sys.stderr)
+        return 1
+
+    # 2) strength reduction: a real SAT query, crosschecked at cadence 1
+    report = _optimize(STRENGTH, crosscheck=1)
+    if len(report.rewrites) != 1 or report.rewrites[0].rule != "strength_mul":
+        print(f"superopt_smoke: strength reduction not applied: "
+              f"{[r.rule for r in report.rewrites]!r}", file=sys.stderr)
+        return 1
+    stats = report.proof_stats
+    if stats["queries"] < 1 or stats["unsat"] < 1:
+        print(f"superopt_smoke: expected a real UNSAT query, got "
+              f"{stats!r}", file=sys.stderr)
+        return 1
+    if stats["crosschecks"] < 1:
+        print(f"superopt_smoke: crosscheck cadence 1 ran "
+              f"{stats['crosschecks']} crosschecks, want >= 1",
+              file=sys.stderr)
+        return 1
+    if stats["divergences"] != 0 or stats["selfcheck_failures"] != 0:
+        print(f"superopt_smoke: divergences/selfcheck failures in "
+              f"{stats!r}", file=sys.stderr)
+        return 1
+    if not report.rewrites[0].after == ("PUSH1 0x3", "SHL"):
+        print(f"superopt_smoke: strength reduction emitted "
+              f"{report.rewrites[0].after!r}, want PUSH1 0x3; SHL",
+              file=sys.stderr)
+        return 1
+
+    # 3) the env knob drives the crosscheck cadence via tpu_config
+    old = os.environ.get("MYTHRIL_TPU_SUPEROPT_CROSSCHECK")
+    os.environ["MYTHRIL_TPU_SUPEROPT_CROSSCHECK"] = "1"
+    try:
+        report = _optimize(STRENGTH)
+        if report.proof_stats["crosschecks"] < 1:
+            print("superopt_smoke: MYTHRIL_TPU_SUPEROPT_CROSSCHECK=1 "
+                  "did not enable crosschecking", file=sys.stderr)
+            return 1
+    finally:
+        if old is None:
+            os.environ.pop("MYTHRIL_TPU_SUPEROPT_CROSSCHECK", None)
+        else:
+            os.environ["MYTHRIL_TPU_SUPEROPT_CROSSCHECK"] = old
+
+    # 4) gas-table parity with the interpreter's opcode schedule
+    from mythril_tpu.ops.opcodes import GAS, OPCODES
+    from mythril_tpu.superopt.gas import parity_errors
+    errors = parity_errors(OPCODES, GAS)
+    if errors:
+        print(f"superopt_smoke: gas table drift: {errors[:3]!r}",
+              file=sys.stderr)
+        return 1
+
+    print("SUPEROPT_SMOKE=ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,9 @@ Reads a Chrome ``trace_event`` JSON written by the observe span tracer
 * XLA compile accounting: every ``xla.compile`` span with its
   clause-shape key and cost — the per-shape compile cliff that the pow2
   bucketing exists to bound;
+* gas-superoptimization rollup (``superopt.prove`` spans): obligation/
+  query counts, the unsat/sat/unknown proof split, and whether the
+  proofs rode the batched device dispatch;
 * serve rollup (traces from `myth-tpu serve` only): warmup attributed
   separately from request time, then request id -> duration, warm vs
   cold dispatch counts, and the per-phase breakdown inside each request
@@ -209,6 +212,7 @@ def report(events: List[dict], other: Dict[str, object]) -> str:
         lines.append("  (no xla.compile spans — every bucket was warm)")
 
     lines.extend(_staticanalysis_section(spans))
+    lines.extend(_superopt_section(spans))
     lines.extend(_serve_section(spans, instants))
 
     if instants:
@@ -237,6 +241,29 @@ def _staticanalysis_section(spans: List[dict]) -> List[str]:
         detail = ", ".join(f"{k}={v}" for k, v in sorted(args.items()))
         lines.append(f"  {span['name']:<12} {_fmt_us(float(span.get('dur', 0.0))):>9}"
                      + (f"  ({detail})" if detail else ""))
+    return lines
+
+
+def _superopt_section(spans: List[dict]) -> List[str]:
+    """Gas-superoptimization rollup: one line per ``superopt.prove``
+    span with its obligation/query counts and the proof outcome split
+    (unsat = accepted equivalences, sat = distinguishable candidates,
+    unknown = ladder exhaustions), plus whether the proofs rode the
+    batched device dispatch. Empty (section omitted) for traces without
+    superopt spans, so existing reports are unchanged."""
+    proofs = [s for s in spans if s["name"] == "superopt.prove"]
+    if not proofs:
+        return []
+    lines = ["", "== gas superoptimization (superopt.prove) =="]
+    for span in sorted(proofs, key=lambda s: float(s.get("ts", 0.0))):
+        args = span.get("args", {})
+        lines.append(
+            f"  {_fmt_us(float(span.get('dur', 0.0))):>9}  "
+            f"obligations={args.get('obligations', '?')} "
+            f"queries={args.get('queries', '?')} "
+            f"unsat={args.get('unsat', '?')} sat={args.get('sat', '?')} "
+            f"unknown={args.get('unknown', '?')} "
+            f"batched={args.get('batched', '?')}")
     return lines
 
 
